@@ -1,0 +1,11 @@
+"""dorpatch-tpu: a TPU-native framework for distributed, occlusion-robust
+adversarial patches and certified-defense evaluation.
+
+Rebuilds the capabilities of CGCL-codes/DorPatch (NDSS 2024) as idiomatic
+JAX/XLA: jit+vmap'd EOT optimization, batched-scan PatchCleanser certification,
+on-device adaptive optimizer state, and mesh-sharded mask/EOT axes.
+"""
+
+__version__ = "0.1.0"
+
+from dorpatch_tpu.config import AttackConfig, DefenseConfig, ExperimentConfig, NUM_CLASSES  # noqa: F401

@@ -1,0 +1,104 @@
+"""Configuration for the dorpatch-tpu framework.
+
+One dataclass surfaces every knob of the reference pipeline, including the
+constants that the reference hard-codes inside function bodies
+(`/root/reference/attack.py:52-53,65,83,87-89`, `/root/reference/main.py:61,84`).
+The config is also the persistence key for the results directory, mirroring the
+reference's `generate_saving_path` contract (`/root/reference/utils.py:24-44`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+NUM_CLASSES = {"imagenet": 1000, "cifar10": 10, "cifar100": 100}
+
+
+@dataclasses.dataclass(frozen=True)
+class AttackConfig:
+    """DorPatch optimizer hyper-parameters.
+
+    Defaults replicate the reference (`/root/reference/attack.py:51-53` signature
+    defaults plus in-body constants).
+    """
+
+    patch_budget: float = 0.12
+    targeted: bool = False
+    lr: float = 1e-2
+    confidence: float = 1e-1
+    clip_min: float = 0.0
+    clip_max: float = 1.0
+    max_iterations: int = 5000
+    basic_unit: int = 7
+    selection: str = "topk"
+    dropout: int = 2               # 0: occlusion EOT off (identity mask), 1: single, 2: double masks
+    sampling_size: int = 128       # EOT samples (occlusion masks) per step
+    density: float = 1e-3          # density regularization coefficient
+    structured: float = 1e-3       # structured (TV) loss coefficient
+    eps: float = 4.0               # L2 budget for the patch delta
+    dual: bool = False             # second independent occlusion layer per sample
+    num_patch: int = -1            # bookkeeping only (results path), as in reference
+
+    # In-body constants of the reference's generate():
+    patience: int = 200                        # lr-decay patience (attack.py:65)
+    coeff_group_lasso: float = 1e-5            # attack.py:87
+    scale_up: float = 1.2                      # attack.py:88
+    # scale_down = sqrt(scale_up**3), derived (attack.py:89)
+    dropout_sizes: Tuple[float, ...] = (0.015, 0.03, 0.06, 0.12)  # attack.py:83
+    success_threshold: float = 1e-1            # attack_success = loss_adv < 1e-1 (attack.py:255)
+    switch_iteration: int = 500                # untargeted->targeted switch (attack.py:169)
+    sweep_interval: int = 100                  # collect_failure cadence (attack.py:187)
+    failure_sampling_start: int = 1000         # failure-biased sampling start (attack.py:193)
+    lr_floor: float = 0.1 / 256.0              # lr clip floor (attack.py:307)
+    lr_stop: float = 1e-3                      # all-lr early-stop threshold (attack.py:311)
+    lr_decay: float = 0.1                      # patience decay factor (attack.py:306)
+    loss_decay_margin: float = 1e-3            # improvement margin (attack.py:275)
+    report_interval: int = 20                  # metrics cadence (attack.py:318)
+    adapt_start: int = 200                     # stage-0 coeff adaptation start (attack.py:294)
+
+    @property
+    def scale_down(self) -> float:
+        return float(self.scale_up ** 1.5)
+
+
+@dataclasses.dataclass(frozen=True)
+class DefenseConfig:
+    """PatchCleanser double-masking defense (`/root/reference/main.py:61`)."""
+
+    ratios: Tuple[float, ...] = (0.015, 0.03, 0.06, 0.12)
+    n_patch: int = 1
+    num_mask_per_axis: int = 6
+    mask_fill: float = 0.5          # gray fill (PatchCleanser.py:100)
+    chunk_size: int = 64            # certification sweep chunking (PatchCleanser.py:102)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """End-to-end experiment: the reference's CLI surface (`/root/reference/main.py:8-41`)
+    plus backend/mesh selection."""
+
+    dataset: str = "imagenet"
+    data_dir: str = "/home/data/data"
+    model_dir: str = "pretrained_models/"
+    base_arch: str = "resnetv2"
+    attack_name: str = "DorPatch"
+    batch_size: int = 1
+    num_batches: int = 10           # hard cap of the reference driver (main.py:84)
+    seed: int = 1234
+    backend: str = "jax-tpu"        # {"torch", "jax-tpu"}
+    device: str = "0"
+    results_root: str = "results"
+    synthetic_data: bool = False    # run without datasets on disk
+    img_size: int = 224
+
+    # Mesh: data axis (images, DCN across slices) x mask axis (EOT samples, ICI).
+    mesh_data: int = 1
+    mesh_mask: int = 1
+
+    attack: AttackConfig = dataclasses.field(default_factory=AttackConfig)
+    defense: DefenseConfig = dataclasses.field(default_factory=DefenseConfig)
+
+    @property
+    def num_classes(self) -> int:
+        return NUM_CLASSES[self.dataset]

@@ -1,0 +1,197 @@
+"""R-covering occlusion-mask geometry, TPU-first.
+
+Reimplements the PatchCleanser mask-set construction
+(`/root/reference/defenses/PatchCleanser.py:6-59`) with a representation chosen
+for XLA rather than materialized boolean tensors: every mask is a small set of
+occlusion *rectangles* `[K, 4]` (row0, row1, col0, col1 half-open), and a
+jit-friendly rasterizer turns gathered rectangle sets into boolean masks
+on-device. This keeps the 2520-mask attack universe at `2520*2*4` int32
+(~80 KB) instead of 126 MB of booleans, shards trivially along the mask axis,
+and lets the hot loop rasterize only the 128 sampled masks per step.
+
+Mask convention matches the reference: **True = pixel kept, False = occluded**.
+A mask with K rectangles rasterizes to `AND_k (outside rect_k)`, which is
+exactly the reference's elementwise product of single-rectangle masks
+(`PatchCleanser.py:23-24`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MaskSpec(NamedTuple):
+    """Geometry of one R-covering mask family (`PatchCleanser.py:8-17`)."""
+
+    img_size: int
+    patch_ratio: float
+    n_patch: int
+    mask_size: int
+    stride: int
+    window_size: int
+    num_mask_per_axis: int
+
+
+def geometry(
+    img_size: int,
+    patch_ratio: float = 0.03,
+    n_patch: int = 1,
+    num_mask_per_axis: int = 6,
+) -> MaskSpec:
+    """Compute mask/stride/window sizes (`PatchCleanser.py:11-17`).
+
+    mask_size = floor(sqrt(img^2 * ratio / n_patch));
+    stride = ceil((img - mask + 1) / num_mask_per_axis);
+    window = mask + stride - 1.
+    """
+    mask_size = math.floor(math.sqrt(img_size**2 * patch_ratio / n_patch))
+    stride = math.ceil((img_size - mask_size + 1) / num_mask_per_axis)
+    window_size = mask_size + stride - 1
+    return MaskSpec(
+        img_size=img_size,
+        patch_ratio=patch_ratio,
+        n_patch=n_patch,
+        mask_size=mask_size,
+        stride=stride,
+        window_size=window_size,
+        num_mask_per_axis=num_mask_per_axis,
+    )
+
+
+def first_order_rects(spec: MaskSpec) -> np.ndarray:
+    """The `num^2` single occlusion windows (`PatchCleanser.py:44-59`).
+
+    Returns int32 `[num^2, 4]` rows of (r0, r1, c0, c1), half-open, clipped to
+    the image; enumeration order is row-major `i * num + j` as in the reference.
+    """
+    num = spec.num_mask_per_axis
+    rects = np.zeros((num * num, 4), dtype=np.int32)
+    for i in range(num):
+        for j in range(num):
+            r0 = spec.stride * i
+            r1 = min(spec.img_size, r0 + spec.window_size)
+            c0 = spec.stride * j
+            c1 = min(spec.img_size, c0 + spec.window_size)
+            rects[i * num + j] = (r0, r1, c0, c1)
+    return rects
+
+
+def pair_rects(rects: np.ndarray) -> np.ndarray:
+    """Upper-triangular (i<j) pairs of rectangles, `[C(M,2), 2, 4]`.
+
+    Row-major pair order matches the reference's `triu(...,diagonal=1)`
+    selection of the MxM product (`PatchCleanser.py:23-29`):
+    (0,1),(0,2),...,(0,M-1),(1,2),...
+    """
+    n = rects.shape[0]
+    ii, jj = np.triu_indices(n, k=1)
+    return np.stack([rects[ii], rects[jj]], axis=1).astype(np.int32)
+
+
+def pair_index(n: int, i: np.ndarray, j: np.ndarray) -> np.ndarray:
+    """Index into `pair_rects` output for pair (i<j) of an n-mask family."""
+    i = np.asarray(i)
+    j = np.asarray(j)
+    return (i * (2 * n - i - 1)) // 2 + (j - i - 1)
+
+
+def mask_sets(spec: MaskSpec) -> Tuple[np.ndarray, np.ndarray]:
+    """(mask_set, double_mask_set) as rectangle sets.
+
+    n_patch=1: singles `[M,1,4]` and pairs `[C(M,2),2,4]`.
+    n_patch=2: pairs and basic-major triples `[M*C(M,2),3,4]`
+    (`PatchCleanser.py:31-39`; the reference's n_patch=2 triple order is
+    `basic[:,None] * combined[None,:]`, i.e. basic-major).
+    """
+    basic = first_order_rects(spec)
+    pairs = pair_rects(basic)
+    if spec.n_patch == 1:
+        return basic[:, None, :], pairs
+    if spec.n_patch == 2:
+        n_basic, n_pairs = basic.shape[0], pairs.shape[0]
+        triples = np.concatenate(
+            [
+                np.broadcast_to(basic[:, None, None, :], (n_basic, n_pairs, 1, 4)),
+                np.broadcast_to(pairs[None, :, :, :], (n_basic, n_pairs, 2, 4)),
+            ],
+            axis=2,
+        ).reshape(n_basic * n_pairs, 3, 4)
+        return pairs, triples.astype(np.int32)
+    raise NotImplementedError(f"n_patch={spec.n_patch}")
+
+
+def pad_rects(rects: np.ndarray, k: int) -> np.ndarray:
+    """Pad the rectangle axis to K entries with empty (0,0,0,0) rectangles.
+
+    Empty rectangles rasterize to 'occlude nothing', so padding is a no-op on
+    the resulting mask; it gives heterogeneous mask families a uniform shape.
+    """
+    n, cur, _ = rects.shape
+    if cur > k:
+        raise ValueError(f"cannot pad {cur} rectangles down to K={k}")
+    if cur == k:
+        return rects
+    pad = np.zeros((n, k - cur, 4), dtype=np.int32)
+    return np.concatenate([rects, pad], axis=1)
+
+
+def dropout_universe(
+    img_size: int,
+    dropout: int = 2,
+    dropout_sizes: Sequence[float] = (0.015, 0.03, 0.06, 0.12),
+    num_mask_per_axis: int = 6,
+) -> np.ndarray:
+    """The attack's occlusion universe (`/root/reference/attack.py:25-31,83-85`).
+
+    Concatenation over dropout ratios of the n_patch=1 mask family:
+    dropout=1 -> single masks (`[len(sizes)*M, 1, 4]`),
+    dropout=2 -> double masks (`[len(sizes)*C(M,2), 2, 4]`).
+    dropout=0 -> one empty mask (occlusion EOT disabled; the reference's
+    dropout=0 branch is unreachable/broken — `attack.py:54-55,84-85` would
+    concat None — so this is the deliberate repair: a single identity mask).
+    """
+    if dropout == 0:
+        return np.zeros((1, 1, 4), dtype=np.int32)
+    if dropout not in (1, 2):
+        raise ValueError(f"dropout={dropout} (supported: 0, 1, 2)")
+    parts = []
+    for ratio in dropout_sizes:
+        spec = geometry(img_size, ratio, n_patch=1, num_mask_per_axis=num_mask_per_axis)
+        singles, doubles = mask_sets(spec)
+        parts.append(singles if dropout == 1 else doubles)
+    return np.concatenate(parts, axis=0)
+
+
+def rasterize(rects: jax.Array, img_size: int) -> jax.Array:
+    """Rasterize rectangle sets `[..., K, 4]` to boolean masks `[..., H, W]`.
+
+    True = keep, False = occluded (reference convention,
+    `PatchCleanser.py:49-58`). Pure jnp; safe inside jit/vmap/scan and under
+    sharding along the leading mask axis.
+    """
+    rects = jnp.asarray(rects, dtype=jnp.int32)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (img_size, img_size), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (img_size, img_size), 1)
+    r0 = rects[..., 0][..., None, None]
+    r1 = rects[..., 1][..., None, None]
+    c0 = rects[..., 2][..., None, None]
+    c1 = rects[..., 3][..., None, None]
+    occluded = (rows >= r0) & (rows < r1) & (cols >= c0) & (cols < c1)
+    return ~jnp.any(occluded, axis=-3)
+
+
+def apply_masks(imgs: jax.Array, masks: jax.Array, fill: float = 0.5) -> jax.Array:
+    """Occlude images with a set of masks: `img*m + fill*(1-m)`.
+
+    imgs: `[B, H, W, C]` (NHWC, TPU-native); masks: `[N, H, W]` boolean.
+    Returns `[B, N, H, W, C]`. Mirrors `PatchCleanser.mask`
+    (`PatchCleanser.py:99-100`) and the attack's fused mask-apply
+    (`attack.py:206`).
+    """
+    m = masks[None, :, :, :, None].astype(imgs.dtype)
+    return imgs[:, None] * m + fill * (1.0 - m)

@@ -1,0 +1,6 @@
+#!/bin/bash
+# CPU test runner. Unsetting PALLAS_AXON_POOL_IPS skips the site-level TPU
+# plugin registration (which claims the exclusive device grant and can block
+# behind any other live JAX process); tests run on an 8-device virtual CPU
+# mesh regardless (tests/conftest.py).
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"

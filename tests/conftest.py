@@ -1,0 +1,14 @@
+"""Test bootstrap: force a virtual 8-device CPU platform before jax imports.
+
+This is the scale-free distributed-testing strategy from SURVEY.md §4: every
+sharding/mesh test runs against 8 virtual CPU devices, no TPU required.
+"""
+
+import os
+
+# Force-override: the environment may pin JAX_PLATFORMS to a TPU platform
+# globally; tests always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

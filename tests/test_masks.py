@@ -1,0 +1,130 @@
+"""Golden tests for mask geometry (SURVEY.md §2 geometry table)."""
+
+import numpy as np
+import pytest
+
+from dorpatch_tpu import masks
+
+
+# Expected geometry at 224, n_patch=1 (SURVEY.md §2: mask-window geometry row).
+GOLDEN_224 = {
+    0.015: (27, 33, 59),
+    0.03: (38, 32, 69),
+    0.06: (54, 29, 82),
+    0.12: (77, 25, 101),
+}
+
+
+def _slice_rasterize(rects, img_size):
+    """Independent oracle: build boolean masks by slice-assignment."""
+    rects = np.asarray(rects)
+    out = np.ones((rects.shape[0], img_size, img_size), dtype=bool)
+    for n in range(rects.shape[0]):
+        for r0, r1, c0, c1 in rects[n]:
+            out[n, r0:r1, c0:c1] = False
+    return out
+
+
+@pytest.mark.parametrize("ratio", sorted(GOLDEN_224))
+def test_geometry_golden(ratio):
+    spec = masks.geometry(224, ratio, n_patch=1)
+    mask_size, stride, window = GOLDEN_224[ratio]
+    assert spec.mask_size == mask_size
+    assert spec.stride == stride
+    assert spec.window_size == window
+    assert spec.num_mask_per_axis == 6
+
+
+def test_set_counts():
+    spec = masks.geometry(224, 0.03, n_patch=1)
+    singles, doubles = masks.mask_sets(spec)
+    assert singles.shape == (36, 1, 4)
+    assert doubles.shape == (630, 2, 4)  # C(36,2)
+
+    spec2 = masks.geometry(224, 0.03, n_patch=2)
+    pairs, triples = masks.mask_sets(spec2)
+    assert pairs.shape == (630, 2, 4)
+    assert triples.shape == (36 * 630, 3, 4)
+
+
+def test_universe_counts():
+    uni2 = masks.dropout_universe(224, dropout=2)
+    assert uni2.shape == (2520, 2, 4)  # 4 ratios x 630
+    uni1 = masks.dropout_universe(224, dropout=1)
+    assert uni1.shape == (144, 1, 4)
+    uni0 = masks.dropout_universe(224, dropout=0)
+    assert uni0.shape == (1, 1, 4)
+    assert np.asarray(masks.rasterize(uni0, 224)).all()  # identity mask
+    with pytest.raises(ValueError):
+        masks.dropout_universe(224, dropout=3)
+    with pytest.raises(ValueError):
+        masks.pad_rects(np.zeros((4, 2, 4), np.int32), 1)
+
+
+def test_rects_cover_image():
+    """R-covering property: strides place 6 windows spanning the image."""
+    for ratio in GOLDEN_224:
+        spec = masks.geometry(224, ratio)
+        rects = masks.first_order_rects(spec)
+        assert rects.shape == (36, 4)
+        # Last window reaches the image edge.
+        assert rects[:, 1].max() == 224
+        assert rects[:, 3].max() == 224
+        # Consecutive windows overlap by at least mask_size - 1 so every
+        # mask_size x mask_size patch is fully covered by some window.
+        starts = sorted(set(rects[:, 0].tolist()))
+        for a, b in zip(starts, starts[1:]):
+            assert b - a <= spec.window_size - spec.mask_size + 1
+
+
+def test_rasterize_matches_slicing_oracle():
+    spec = masks.geometry(96, 0.06)
+    singles, doubles = masks.mask_sets(spec)
+    got_s = np.asarray(masks.rasterize(singles, 96))
+    np.testing.assert_array_equal(got_s, _slice_rasterize(singles, 96))
+    got_d = np.asarray(masks.rasterize(doubles[:50], 96))
+    np.testing.assert_array_equal(got_d, _slice_rasterize(doubles[:50], 96))
+
+
+def test_double_mask_is_product_of_singles():
+    """Pair masks equal the elementwise AND of their constituent singles
+    (reference builds them as products, PatchCleanser.py:23-24)."""
+    spec = masks.geometry(64, 0.06)
+    basic = masks.first_order_rects(spec)
+    single_m = np.asarray(masks.rasterize(basic[:, None, :], 64))
+    _, doubles = masks.mask_sets(spec)
+    pair_m = np.asarray(masks.rasterize(doubles, 64))
+    ii, jj = np.triu_indices(36, k=1)
+    np.testing.assert_array_equal(pair_m, single_m[ii] & single_m[jj])
+
+
+def test_pair_index():
+    n = 36
+    ii, jj = np.triu_indices(n, k=1)
+    idx = masks.pair_index(n, ii, jj)
+    np.testing.assert_array_equal(idx, np.arange(len(ii)))
+
+
+def test_pad_rects_is_noop_on_mask():
+    spec = masks.geometry(64, 0.06)
+    singles, _ = masks.mask_sets(spec)
+    padded = masks.pad_rects(singles, 3)
+    assert padded.shape == (36, 3, 4)
+    np.testing.assert_array_equal(
+        np.asarray(masks.rasterize(padded, 64)),
+        np.asarray(masks.rasterize(singles, 64)),
+    )
+
+
+def test_apply_masks_fill():
+    import jax.numpy as jnp
+
+    spec = masks.geometry(32, 0.12)
+    singles, _ = masks.mask_sets(spec)
+    m = masks.rasterize(singles[:4], 32)
+    imgs = jnp.ones((2, 32, 32, 3)) * 0.25
+    out = np.asarray(masks.apply_masks(imgs, m, fill=0.5))
+    assert out.shape == (2, 4, 32, 32, 3)
+    mn = np.asarray(m)
+    assert np.allclose(out[:, :, :, :, 0][:, mn], 0.25)
+    assert np.allclose(out[:, :, :, :, 0][:, ~mn], 0.5)
