@@ -12,6 +12,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Tuple
 
+from dorpatch_tpu.masks import DEFAULT_RATIOS, NUM_MASKS_PER_AXIS
+
 NUM_CLASSES = {"imagenet": 1000, "cifar10": 10, "cifar100": 100}
 
 
@@ -45,7 +47,7 @@ class AttackConfig:
     coeff_group_lasso: float = 1e-5            # attack.py:87
     scale_up: float = 1.2                      # attack.py:88
     # scale_down = sqrt(scale_up**3), derived (attack.py:89)
-    dropout_sizes: Tuple[float, ...] = (0.015, 0.03, 0.06, 0.12)  # attack.py:83
+    dropout_sizes: Tuple[float, ...] = DEFAULT_RATIOS  # attack.py:83
     success_threshold: float = 1e-1            # attack_success = loss_adv < 1e-1 (attack.py:255)
     switch_iteration: int = 500                # untargeted->targeted switch (attack.py:169)
     sweep_interval: int = 100                  # collect_failure cadence (attack.py:187)
@@ -66,9 +68,9 @@ class AttackConfig:
 class DefenseConfig:
     """PatchCleanser double-masking defense (`/root/reference/main.py:61`)."""
 
-    ratios: Tuple[float, ...] = (0.015, 0.03, 0.06, 0.12)
+    ratios: Tuple[float, ...] = DEFAULT_RATIOS
     n_patch: int = 1
-    num_mask_per_axis: int = 6
+    num_mask_per_axis: int = NUM_MASKS_PER_AXIS
     mask_fill: float = 0.5          # gray fill (PatchCleanser.py:100)
     chunk_size: int = 64            # certification sweep chunking (PatchCleanser.py:102)
 

@@ -1,0 +1,137 @@
+"""Loss functions and projections for the DorPatch optimizer, as pure jnp.
+
+Everything here is NHWC (TPU-native layout): images `[B, H, W, C]`, patch
+masks `[B, H, W, 1]`. Reference semantics are reproduced exactly — including
+the *asymmetric* gradient flow of the reference's total-variation variant
+(`/root/reference/attack.py:33-45`), where gradients reach only the shifted
+operand of each finite difference — but expressed with `stop_gradient` and
+`lax.reduce_window` instead of in-place tensor surgery and all-ones conv
+modules.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def cw_margin_switchable(
+    logits: jax.Array,
+    labels: jax.Array,
+    num_classes: int,
+    targeted: jax.Array,
+    confidence: float = 0.0,
+) -> jax.Array:
+    """Carlini-Wagner margin loss with a (possibly traced) `targeted` flag
+    (`/root/reference/attack.py:10-23`).
+
+    logits `[N, C]`, labels `[N]` int. Untargeted: hinge on
+    `conf + logit_label - max_other`; targeted: `conf + max_other - logit_label`.
+    The max over "others" zeroes the label logit and pushes its slot to
+    exactly -1e4, matching the reference's `(1-onehot)*logits - onehot*1e4`.
+    The flag may be a traced boolean: the attack flips untargeted -> targeted
+    mid-run (`attack.py:169-176`), so the flag lives in the jitted carry and
+    selects via `where` instead of Python control flow. Returns `[N]`.
+    """
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=logits.dtype)
+    real = jnp.sum(logits * onehot, axis=-1)
+    other = jnp.max((1.0 - onehot) * logits - onehot * 1e4, axis=-1)
+    margin = jnp.where(targeted, other - real, real - other)
+    return jnp.maximum(confidence + margin, 0.0)
+
+
+def cw_margin(
+    logits: jax.Array,
+    labels: jax.Array,
+    num_classes: int,
+    targeted: bool,
+    confidence: float = 0.0,
+) -> jax.Array:
+    """`cw_margin_switchable` with a static Python `targeted` flag."""
+    return cw_margin_switchable(logits, labels, num_classes, jnp.asarray(targeted), confidence)
+
+
+def local_variance(x: jax.Array):
+    """Directional absolute differences with one-sided gradients
+    (`/root/reference/attack.py:33-39`).
+
+    x: `[B, H, W, C]`. Returns `(lv, grad_lr, grad_ud)`, each `[B, H, W, C]`.
+
+    The reference builds each directional term by in-place subtracting the
+    shifted image from a *detached* clone, so autograd reaches only the
+    shifted operand: `grad_lr[..., w] = |sg(x[..., w]) - x[..., w+1]|` for
+    w < W-1, and the last column is `sg(x[..., W-1])` untouched (outside the
+    abs). Same for rows. We reproduce that flow with stop_gradient.
+    """
+    sg = lax.stop_gradient(x)
+    diff_lr = jnp.abs(sg[:, :, :-1, :] - x[:, :, 1:, :])
+    grad_lr = jnp.concatenate([diff_lr, sg[:, :, -1:, :]], axis=2)
+    diff_ud = jnp.abs(sg[:, :-1, :, :] - x[:, 1:, :, :])
+    grad_ud = jnp.concatenate([diff_ud, sg[:, -1:, :, :]], axis=1)
+    return grad_lr + grad_ud, grad_lr, grad_ud
+
+
+def min_var_weighted_variance(x: jax.Array) -> jax.Array:
+    """TV weighted by the smaller directional gradient
+    (`/root/reference/attack.py:41-45`): smoothness penalty aligned with image
+    structure. Gradients flow through both the sum and the selected weight
+    (the selection itself is non-differentiable), matching `torch.where`.
+    """
+    lv, grad_lr, grad_ud = local_variance(x)
+    return lv * jnp.where(grad_lr > grad_ud, grad_ud, grad_lr)
+
+
+def structural_loss(adv_x: jax.Array, local_var_x: jax.Array) -> jax.Array:
+    """Per-image structural loss (`/root/reference/attack.py:227-228`):
+    channel-mean weighted TV of adv_x, normalized by the clean image's local
+    variance (+1e-5), averaged over pixels. adv_x `[B,H,W,C]`,
+    local_var_x `[B,H,W]` precomputed from the clean image. Returns `[B]`.
+    """
+    mv = jnp.mean(min_var_weighted_variance(adv_x), axis=-1)
+    return jnp.mean(mv / (local_var_x + 1e-5), axis=(1, 2))
+
+
+def window_sum(x: jax.Array, window: int) -> jax.Array:
+    """Non-overlapping window sums: `[B, H, W, 1] -> [B, H/w, W/w, 1]`.
+
+    TPU-native replacement for the reference's all-ones stride-w conv modules
+    (`/root/reference/attack.py:72-80`): a `lax.reduce_window` add-reduction,
+    which XLA lowers to an efficient pooling op instead of a dense conv.
+    """
+    return lax.reduce_window(
+        x, 0.0, lax.add, (1, window, window, 1), (1, window, window, 1), "VALID"
+    )
+
+
+def group_lasso(adv_mask: jax.Array, basic_unit: int) -> jax.Array:
+    """Group-wise sparsity over basic_unit x basic_unit cells
+    (`/root/reference/attack.py:242-245`): `unit * sum_g sqrt(sum_cell m^2)`.
+    adv_mask `[B, H, W, 1]`. Returns `[B]`.
+    """
+    g = window_sum(adv_mask**2, basic_unit)
+    return basic_unit * jnp.sum(jnp.sqrt(g), axis=(1, 2, 3))
+
+
+def density_loss(adv_mask: jax.Array, window: int) -> jax.Array:
+    """Variance of coarse-cell mask mass (`/root/reference/attack.py:76-80,237`):
+    low variance spreads patch mass across the image (the "distributed"
+    property). Uses the unbiased (ddof=1) variance to match `torch.var`.
+    adv_mask `[B, H, W, 1]`, window = H // 8. Returns `[B]`.
+    """
+    cells = window_sum(adv_mask, window)
+    flat = cells.reshape(cells.shape[0], -1)
+    return jnp.var(flat, axis=1, ddof=1)
+
+
+def l2_project(mask: jax.Array, pattern: jax.Array, x: jax.Array, eps: float) -> jax.Array:
+    """Soft L2 projection of the patch delta (`/root/reference/utils.py:105-110`).
+
+    delta = mask * (pattern - x); scaled so ||delta||_2 <= eps per image, with
+    the norm detached (gradients see the scale as a constant), exactly as the
+    reference. mask `[B,H,W,1]`, pattern/x `[B,H,W,C]`. Returns delta `[B,H,W,C]`.
+    """
+    delta = mask * (pattern - x)
+    norm = lax.stop_gradient(jnp.sqrt(jnp.sum(delta**2, axis=(1, 2, 3))))
+    scale = jnp.minimum(eps / norm, 1.0)
+    return delta * scale[:, None, None, None]

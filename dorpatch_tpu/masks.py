@@ -25,6 +25,13 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# Single source of truth for the dropout/defense ratio schedule and the
+# R-covering axis count (`/root/reference/attack.py:83`, `PatchCleanser.py:13`).
+# config.AttackConfig / config.DefenseConfig reference these.
+DEFAULT_RATIOS: Tuple[float, ...] = (0.015, 0.03, 0.06, 0.12)
+NUM_MASKS_PER_AXIS: int = 6
+
+
 class MaskSpec(NamedTuple):
     """Geometry of one R-covering mask family (`PatchCleanser.py:8-17`)."""
 
@@ -41,7 +48,7 @@ def geometry(
     img_size: int,
     patch_ratio: float = 0.03,
     n_patch: int = 1,
-    num_mask_per_axis: int = 6,
+    num_mask_per_axis: int = NUM_MASKS_PER_AXIS,
 ) -> MaskSpec:
     """Compute mask/stride/window sizes (`PatchCleanser.py:11-17`).
 
@@ -143,8 +150,8 @@ def pad_rects(rects: np.ndarray, k: int) -> np.ndarray:
 def dropout_universe(
     img_size: int,
     dropout: int = 2,
-    dropout_sizes: Sequence[float] = (0.015, 0.03, 0.06, 0.12),
-    num_mask_per_axis: int = 6,
+    dropout_sizes: Sequence[float] = DEFAULT_RATIOS,
+    num_mask_per_axis: int = NUM_MASKS_PER_AXIS,
 ) -> np.ndarray:
     """The attack's occlusion universe (`/root/reference/attack.py:25-31,83-85`).
 

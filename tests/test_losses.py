@@ -1,0 +1,194 @@
+"""Loss-semantics tests: values and *gradients* cross-checked against
+independent torch oracles implementing the documented reference semantics
+(SURVEY.md §2: CW margin, asymmetric TV, group lasso, density, L2 clip)."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu import losses
+
+RNG = np.random.default_rng(0)
+
+
+def _rand(*shape):
+    return RNG.uniform(0, 1, size=shape).astype(np.float32)
+
+
+# ---------- torch oracles (NCHW), written to the documented semantics ----------
+
+def torch_cw(logits, y, num_classes, targeted, confidence):
+    oh = torch.nn.functional.one_hot(y, num_classes).float()
+    real = (logits * oh).sum(1)
+    other = ((1.0 - oh) * logits - oh * 1e4).max(1).values
+    m = other - real if targeted else real - other
+    return torch.clamp(confidence + m, min=0.0)
+
+
+def torch_directional_tv(x):
+    """One-sided-gradient directional diffs: detached base minus live shift."""
+    base = x.detach().clone()
+    lr = torch.cat(
+        [(base[..., :, :-1] - x[..., :, 1:]).abs(), base[..., :, -1:]], dim=-1)
+    ud = torch.cat(
+        [(base[..., :-1, :] - x[..., 1:, :]).abs(), base[..., -1:, :]], dim=-2)
+    return lr + ud, lr, ud
+
+
+def torch_mvwv(x):
+    lv, lr, ud = torch_directional_tv(x)
+    return lv * torch.where(lr > ud, ud, lr)
+
+
+# ---------- CW margin ----------
+
+def test_cw_values_match_torch():
+    logits = _rand(7, 10) * 10
+    y = RNG.integers(0, 10, 7)
+    for targeted in (False, True):
+        got = np.asarray(
+            losses.cw_margin(jnp.asarray(logits), jnp.asarray(y), 10, targeted, 0.1))
+        want = torch_cw(torch.tensor(logits), torch.tensor(y), 10, targeted, 0.1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_cw_hand_computed():
+    logits = jnp.asarray([[2.0, 5.0, 1.0]])
+    y = jnp.asarray([1])
+    # untargeted: conf + real - other = 0.1 + 5 - 2 = 3.1
+    assert float(losses.cw_margin(logits, y, 3, False, 0.1)[0]) == pytest.approx(3.1)
+    # targeted: conf + other - real = 0.1 + 2 - 5 = -2.9 -> clamp 0
+    assert float(losses.cw_margin(logits, y, 3, True, 0.1)[0]) == 0.0
+
+
+def test_cw_switchable_matches_static():
+    logits = jnp.asarray(_rand(5, 4))
+    y = jnp.asarray(RNG.integers(0, 4, 5))
+    for t in (False, True):
+        np.testing.assert_allclose(
+            np.asarray(losses.cw_margin_switchable(logits, y, 4, jnp.asarray(t), 0.1)),
+            np.asarray(losses.cw_margin(logits, y, 4, t, 0.1)),
+            rtol=1e-6,
+        )
+
+
+# ---------- TV variants: values AND gradients ----------
+
+def test_tv_values_and_asymmetric_gradients_match_torch():
+    x_np = _rand(2, 3, 6, 5)  # NCHW for torch
+
+    xt = torch.tensor(x_np, requires_grad=True)
+    out_t = torch_mvwv(xt)
+    out_t.sum().backward()
+
+    xj = jnp.asarray(np.transpose(x_np, (0, 2, 3, 1)))  # NHWC
+
+    def f(x):
+        return losses.min_var_weighted_variance(x).sum()
+
+    val_j, grad_j = jax.value_and_grad(f)(xj)
+    val_t = float(out_t.sum())
+    assert float(val_j) == pytest.approx(val_t, rel=1e-5)
+    grad_j_nchw = np.transpose(np.asarray(grad_j), (0, 3, 1, 2))
+    np.testing.assert_allclose(grad_j_nchw, xt.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_local_variance_last_row_col_is_passthrough():
+    x = jnp.asarray(_rand(1, 4, 4, 1))
+    lv, lr, ud = losses.local_variance(x)
+    np.testing.assert_allclose(np.asarray(lr[:, :, -1, :]), np.asarray(x[:, :, -1, :]))
+    np.testing.assert_allclose(np.asarray(ud[:, -1, :, :]), np.asarray(x[:, -1, :, :]))
+
+
+# ---------- window sums / group lasso / density ----------
+
+def test_window_sum_matches_torch_conv():
+    x_np = _rand(2, 1, 14, 14)
+    conv = torch.nn.Conv2d(1, 1, 7, stride=7, bias=False)
+    with torch.no_grad():
+        conv.weight.fill_(1.0)
+    want = conv(torch.tensor(x_np)).detach().numpy()
+    got = np.asarray(losses.window_sum(jnp.asarray(np.transpose(x_np, (0, 2, 3, 1))), 7))
+    np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), want, rtol=1e-5)
+
+
+def test_group_lasso_value_and_grad():
+    m_np = _rand(2, 1, 14, 14)
+
+    mt = torch.tensor(m_np, requires_grad=True)
+    conv = torch.nn.Conv2d(1, 1, 7, stride=7, bias=False)
+    with torch.no_grad():
+        conv.weight.fill_(1.0)
+    gl_t = 7 * conv(mt**2).sqrt().sum((1, 2, 3))
+    gl_t.sum().backward()
+
+    mj = jnp.asarray(np.transpose(m_np, (0, 2, 3, 1)))
+    val, grad = jax.value_and_grad(lambda m: losses.group_lasso(m, 7).sum())(mj)
+    np.testing.assert_allclose(float(val), float(gl_t.sum()), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.transpose(np.asarray(grad), (0, 3, 1, 2)),
+        mt.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+
+def test_density_uses_unbiased_variance():
+    m_np = _rand(3, 1, 16, 16)
+    conv = torch.nn.Conv2d(1, 1, 2, stride=2, bias=False)
+    with torch.no_grad():
+        conv.weight.fill_(1.0)
+    want = conv(torch.tensor(m_np)).reshape(3, -1).var(1).detach().numpy()  # torch: ddof=1
+    got = np.asarray(losses.density_loss(
+        jnp.asarray(np.transpose(m_np, (0, 2, 3, 1))), 2))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+# ---------- L2 projection ----------
+
+def test_l2_project_scales_to_eps():
+    mask = jnp.ones((1, 8, 8, 1))
+    pattern = jnp.ones((1, 8, 8, 3))
+    x = jnp.zeros((1, 8, 8, 3))
+    delta = losses.l2_project(mask, pattern, x, eps=4.0)
+    norm = float(jnp.sqrt((delta**2).sum()))
+    assert norm == pytest.approx(4.0, rel=1e-5)
+
+
+def test_l2_project_noop_inside_ball_and_detached_norm_grad():
+    m_np = _rand(2, 1, 8, 8)
+    p_np = _rand(2, 3, 8, 8)
+    x_np = _rand(2, 3, 8, 8)
+
+    # torch oracle with detached norm
+    mt = torch.tensor(m_np, requires_grad=True)
+    pt = torch.tensor(p_np, requires_grad=True)
+    xt = torch.tensor(x_np)
+    d = mt * (pt - xt)
+    n = torch.norm(d, p=2, dim=(1, 2, 3)).detach()
+    scale = (4.0 / n).clip(max=1.0).view(-1, 1, 1, 1)
+    (d * scale).sum().backward()
+
+    to_nhwc = lambda a: jnp.asarray(np.transpose(a, (0, 2, 3, 1)))
+
+    def f(m, p):
+        return losses.l2_project(m, p, to_nhwc(x_np), 4.0).sum()
+
+    gm, gp = jax.grad(f, argnums=(0, 1))(to_nhwc(m_np), to_nhwc(p_np))
+    np.testing.assert_allclose(
+        np.transpose(np.asarray(gm), (0, 3, 1, 2)), mt.grad.numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.transpose(np.asarray(gp), (0, 3, 1, 2)), pt.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+    # inside the ball: delta unchanged
+    small = losses.l2_project(jnp.full((1, 4, 4, 1), 0.01), jnp.full((1, 4, 4, 3), 0.5),
+                              jnp.full((1, 4, 4, 3), 0.4), eps=4.0)
+    np.testing.assert_allclose(np.asarray(small), 0.01 * 0.1, rtol=1e-5)
+
+
+def test_structural_loss_shape_and_clean_image_normalization():
+    x = jnp.asarray(_rand(2, 8, 8, 3))
+    lv_clean = jnp.mean(losses.local_variance(x)[0], axis=-1)
+    out = losses.structural_loss(x, lv_clean)
+    assert out.shape == (2,)
+    assert np.all(np.isfinite(np.asarray(out)))
