@@ -1,0 +1,1 @@
+"""Non-JAX backends: the torch oracle path (`--backend torch`)."""

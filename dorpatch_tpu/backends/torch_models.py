@@ -1,0 +1,242 @@
+"""Torch victim models with timm-compatible state_dicts (the parity oracle).
+
+The reference loads its victims through `timm.create_model` + a PatchCleanser
+checkpoint (`/root/reference/utils.py:47-63`). timm is not available in this
+environment, so this module implements the same architectures natively in
+torch with **state_dict keys matching timm**, which keeps the reference's
+checkpoint files (`<model>_cutout2_128_<dataset>.pth`) loadable, and doubles
+as the `--backend torch` oracle for numerical parity tests.
+
+Implemented: resnetv2_50x1_bit_distilled (BiT ResNetV2-50x1). The timm
+contract replicated here: StdConv2dSame with eps=1e-8 and dynamic TF SAME
+padding; GroupNorm(32, eps=1e-5)+ReLU pre-activations; fixed stem
+(ConstantPad2d(1,0) + VALID max-pool); preact projection shortcut; 1x1-conv
+classifier head (`head.fc`).
+"""
+
+from __future__ import annotations
+
+import math
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+def _same_pad(x: torch.Tensor, kernel: int, stride: int) -> torch.Tensor:
+    """TF-style dynamic SAME padding (asymmetric: extra on the right/bottom)."""
+    ih, iw = x.shape[-2:]
+    pad_h = max((math.ceil(ih / stride) - 1) * stride + kernel - ih, 0)
+    pad_w = max((math.ceil(iw / stride) - 1) * stride + kernel - iw, 0)
+    return F.pad(x, (pad_w // 2, pad_w - pad_w // 2, pad_h // 2, pad_h - pad_h // 2))
+
+
+class WSConv2d(nn.Conv2d):
+    """Weight-standardized conv with SAME padding (timm StdConv2dSame)."""
+
+    def __init__(self, in_ch, out_ch, kernel_size, stride=1, eps=1e-8):
+        super().__init__(in_ch, out_ch, kernel_size, stride=stride, padding=0, bias=False)
+        self.eps = eps
+
+    def forward(self, x):
+        w = self.weight
+        mean = w.mean(dim=(1, 2, 3), keepdim=True)
+        var = w.var(dim=(1, 2, 3), keepdim=True, unbiased=False)
+        w = (w - mean) / torch.sqrt(var + self.eps)
+        x = _same_pad(x, self.kernel_size[0], self.stride[0])
+        return F.conv2d(x, w, None, self.stride)
+
+
+class GNRelu(nn.GroupNorm):
+    """GroupNorm+ReLU. Subclasses GroupNorm so state_dict keys are bare
+    `<name>.weight` / `<name>.bias`, matching timm's GroupNormAct."""
+
+    def __init__(self, channels, groups=32):
+        super().__init__(groups, channels, eps=1e-5)
+
+    def forward(self, x):
+        return F.relu(super().forward(x))
+
+
+class Bottleneck(nn.Module):
+    def __init__(self, in_ch, out_ch, stride=1):
+        super().__init__()
+        mid = out_ch // 4
+        self.norm1 = nn.GroupNorm(32, in_ch, eps=1e-5)
+        self.conv1 = WSConv2d(in_ch, mid, 1)
+        self.norm2 = nn.GroupNorm(32, mid, eps=1e-5)
+        self.conv2 = WSConv2d(mid, mid, 3, stride)
+        self.norm3 = nn.GroupNorm(32, mid, eps=1e-5)
+        self.conv3 = WSConv2d(mid, out_ch, 1)
+        if in_ch != out_ch or stride != 1:
+            self.downsample = nn.Module()
+            self.downsample.conv = WSConv2d(in_ch, out_ch, 1, stride)
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        pre = F.relu(self.norm1(x))
+        shortcut = self.downsample.conv(pre) if self.downsample is not None else x
+        y = self.conv1(pre)
+        y = self.conv2(F.relu(self.norm2(y)))
+        y = self.conv3(F.relu(self.norm3(y)))
+        return y + shortcut
+
+
+class ResNetV2Torch(nn.Module):
+    """BiT ResNetV2, timm-compatible module tree / state_dict keys."""
+
+    def __init__(self, num_classes=1000, layers=(3, 4, 6, 3), width=1):
+        super().__init__()
+        wf = width
+        self.stem = nn.Module()
+        self.stem.conv = WSConv2d(3, 64 * wf, 7, 2)
+
+        self.stages = nn.ModuleList()
+        in_ch, out_ch = 64 * wf, 256 * wf
+        for si, depth in enumerate(layers):
+            stage = nn.Module()
+            blocks = nn.ModuleList()
+            for bi in range(depth):
+                stride = 2 if (bi == 0 and si > 0) else 1
+                blocks.append(Bottleneck(in_ch, out_ch, stride))
+                in_ch = out_ch
+            stage.blocks = blocks
+            self.stages.append(stage)
+            out_ch *= 2
+
+        self.norm = GNRelu(in_ch)
+        self.head = nn.Module()
+        self.head.fc = nn.Conv2d(in_ch, num_classes, 1, bias=True)
+
+    def forward(self, x):
+        x = self.stem.conv(x)
+        x = F.max_pool2d(F.pad(x, (1, 1, 1, 1)), 3, 2)
+        for stage in self.stages:
+            for block in stage.blocks:
+                x = block(x)
+        x = self.norm(x)
+        x = x.mean(dim=(2, 3), keepdim=True)
+        x = self.head.fc(x)
+        return x.flatten(1)
+
+
+class ViTBlockTorch(nn.Module):
+    def __init__(self, dim=768, heads=12, mlp_ratio=4):
+        super().__init__()
+        self.num_heads = heads
+        self.norm1 = nn.LayerNorm(dim, eps=1e-6)
+        self.attn = nn.Module()
+        self.attn.qkv = nn.Linear(dim, dim * 3)
+        self.attn.proj = nn.Linear(dim, dim)
+        self.norm2 = nn.LayerNorm(dim, eps=1e-6)
+        self.mlp = nn.Module()
+        self.mlp.fc1 = nn.Linear(dim, dim * mlp_ratio)
+        self.mlp.fc2 = nn.Linear(dim * mlp_ratio, dim)
+
+    def forward(self, x):
+        B, N, D = x.shape
+        h = self.num_heads
+        y = self.norm1(x)
+        qkv = self.attn.qkv(y).reshape(B, N, 3, h, D // h).permute(2, 0, 3, 1, 4)
+        q, k, v = qkv.unbind(0)
+        att = (q @ k.transpose(-2, -1)) * (D // h) ** -0.5
+        att = att.softmax(dim=-1)
+        y = (att @ v).transpose(1, 2).reshape(B, N, D)
+        x = x + self.attn.proj(y)
+        y = self.mlp.fc2(F.gelu(self.mlp.fc1(self.norm2(x))))
+        return x + y
+
+
+class ViTTorch(nn.Module):
+    """ViT-B/16, timm-compatible keys (cls_token, pos_embed, blocks.i.*)."""
+
+    def __init__(self, num_classes=1000, dim=768, depth=12, heads=12, patch=16, img=224):
+        super().__init__()
+        self.patch_embed = nn.Module()
+        self.patch_embed.proj = nn.Conv2d(3, dim, patch, patch)
+        n_tokens = (img // patch) ** 2 + 1
+        self.cls_token = nn.Parameter(torch.zeros(1, 1, dim))
+        self.pos_embed = nn.Parameter(torch.randn(1, n_tokens, dim) * 0.02)
+        self.blocks = nn.ModuleList([ViTBlockTorch(dim, heads) for _ in range(depth)])
+        self.norm = nn.LayerNorm(dim, eps=1e-6)
+        self.head = nn.Linear(dim, num_classes)
+
+    def forward(self, x):
+        B = x.shape[0]
+        x = self.patch_embed.proj(x).flatten(2).transpose(1, 2)  # [B, 196, D]
+        x = torch.cat([self.cls_token.expand(B, -1, -1), x], dim=1) + self.pos_embed
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(self.norm(x)[:, 0])
+
+
+class AffineTorch(nn.Module):
+    def __init__(self, dim):
+        super().__init__()
+        self.alpha = nn.Parameter(torch.ones(dim))
+        self.beta = nn.Parameter(torch.zeros(dim))
+
+    def forward(self, x):
+        return self.alpha * x + self.beta
+
+
+class ResMLPBlockTorch(nn.Module):
+    def __init__(self, dim=384, seq_len=196, mlp_ratio=4, init_values=1e-5):
+        super().__init__()
+        self.norm1 = AffineTorch(dim)
+        self.linear_tokens = nn.Linear(seq_len, seq_len)
+        self.norm2 = AffineTorch(dim)
+        self.mlp_channels = nn.Module()
+        self.mlp_channels.fc1 = nn.Linear(dim, dim * mlp_ratio)
+        self.mlp_channels.fc2 = nn.Linear(dim * mlp_ratio, dim)
+        self.ls1 = nn.Parameter(init_values * torch.ones(dim))
+        self.ls2 = nn.Parameter(init_values * torch.ones(dim))
+
+    def forward(self, x):
+        x = x + self.ls1 * self.linear_tokens(self.norm1(x).transpose(1, 2)).transpose(1, 2)
+        y = self.mlp_channels.fc2(F.gelu(self.mlp_channels.fc1(self.norm2(x))))
+        return x + self.ls2 * y
+
+
+class ResMLPTorch(nn.Module):
+    """ResMLP-24, timm mlp_mixer-compatible keys."""
+
+    def __init__(self, num_classes=1000, dim=384, depth=24, patch=16, img=224):
+        super().__init__()
+        self.patch_embed = nn.Module()
+        self.patch_embed.proj = nn.Conv2d(3, dim, patch, patch)
+        seq_len = (img // patch) ** 2
+        self.blocks = nn.ModuleList([ResMLPBlockTorch(dim, seq_len) for _ in range(depth)])
+        self.norm = AffineTorch(dim)
+        self.head = nn.Linear(dim, num_classes)
+
+    def forward(self, x):
+        x = self.patch_embed.proj(x).flatten(2).transpose(1, 2)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(self.norm(x).mean(dim=1))
+
+
+class Normalized(nn.Module):
+    """[0,1]-input wrapper: normalize with mean/std 0.5 then run the net
+    (reference `NormModel` + `get_normalize`, `/root/reference/utils.py:66-78`)."""
+
+    def __init__(self, net):
+        super().__init__()
+        self.net = net
+
+    def forward(self, x):
+        return self.net((x - 0.5) / 0.5)
+
+
+def create_torch_model(arch: str, num_classes: int) -> nn.Module:
+    """Factory matching the reference's substring-based arch selection
+    (`/root/reference/utils.py:51-58`)."""
+    if arch in ("resnetv2", "resnetv2_50x1_bit_distilled"):
+        return ResNetV2Torch(num_classes=num_classes)
+    if arch in ("vit", "vit_base_patch16_224"):
+        return ViTTorch(num_classes=num_classes)
+    if arch in ("resmlp", "resmlp_24_distilled_224"):
+        return ResMLPTorch(num_classes=num_classes)
+    raise NotImplementedError(f"torch backend arch: {arch}")

@@ -1,0 +1,3 @@
+"""Victim models (flax, NHWC) + timm-checkpoint conversion."""
+
+from dorpatch_tpu.models.registry import Victim, get_model, resolve_arch, checkpoint_path  # noqa: F401

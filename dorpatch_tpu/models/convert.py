@@ -1,0 +1,167 @@
+"""timm/torch checkpoint -> flax params conversion.
+
+The reference's model path loads timm checkpoints from the PatchCleanser
+release (`/root/reference/utils.py:47-63`, `<model>_cutout2_128_<dataset>.pth`).
+This module converts those torch state_dicts into the parameter pytrees of the
+flax models in `dorpatch_tpu.models`, handling OIHW->HWIO conv layout,
+conv1x1-head -> Dense, and GroupNorm weight/bias -> scale/bias renames.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Sequence
+
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _conv_kernel(w) -> np.ndarray:
+    """OIHW -> HWIO."""
+    return _np(w).transpose(2, 3, 1, 0)
+
+
+def load_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Load a torch checkpoint file and return a flat numpy state_dict.
+
+    Accepts either a bare state_dict or the reference checkpoints'
+    `{'state_dict': ...}` wrapper; strips DataParallel `module.` prefixes.
+    """
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(obj, dict) and "state_dict" in obj:
+        obj = obj["state_dict"]
+    out = {}
+    for k, v in obj.items():
+        if k.startswith("module."):
+            k = k[len("module."):]
+        out[k] = _np(v)
+    return out
+
+
+def convert_resnetv2(
+    sd: Mapping[str, np.ndarray], layers: Sequence[int] = (3, 4, 6, 3)
+) -> Dict:
+    """Convert a timm `resnetv2_*_bit*` state_dict to flax ResNetV2 params.
+
+    Key map (timm -> flax):
+      stem.conv.weight                      -> stem_conv/kernel
+      stages.S.blocks.B.normK.{weight,bias} -> stageS_blockB/normK/GroupNorm_0/{scale,bias}
+      stages.S.blocks.B.convK.weight        -> stageS_blockB/convK/kernel
+      stages.S.blocks.B.downsample.conv.weight -> stageS_blockB/downsample_conv/kernel
+      norm.{weight,bias}                    -> norm/GroupNorm_0/{scale,bias}
+      head.fc.{weight,bias} (1x1 conv)      -> head/{kernel,bias} (Dense)
+    """
+    params: Dict = {"stem_conv": {"kernel": _conv_kernel(sd["stem.conv.weight"])}}
+    for si, depth in enumerate(layers):
+        for bi in range(depth):
+            src = f"stages.{si}.blocks.{bi}."
+            blk: Dict = {}
+            for k in (1, 2, 3):
+                blk[f"norm{k}"] = {
+                    "GroupNorm_0": {
+                        "scale": _np(sd[src + f"norm{k}.weight"]),
+                        "bias": _np(sd[src + f"norm{k}.bias"]),
+                    }
+                }
+                blk[f"conv{k}"] = {"kernel": _conv_kernel(sd[src + f"conv{k}.weight"])}
+            if src + "downsample.conv.weight" in sd:
+                blk["downsample_conv"] = {
+                    "kernel": _conv_kernel(sd[src + "downsample.conv.weight"])
+                }
+            params[f"stage{si}_block{bi}"] = blk
+    params["norm"] = {
+        "GroupNorm_0": {"scale": _np(sd["norm.weight"]), "bias": _np(sd["norm.bias"])}
+    }
+    head_w = _np(sd["head.fc.weight"])  # [num_classes, C, 1, 1]
+    params["head"] = {
+        "kernel": head_w[:, :, 0, 0].T,
+        "bias": _np(sd["head.fc.bias"]),
+    }
+    return {"params": params}
+
+
+def _dense(sd, key):
+    """torch Linear -> flax Dense: weight [out,in] -> kernel [in,out]."""
+    return {"kernel": _np(sd[key + ".weight"]).T, "bias": _np(sd[key + ".bias"])}
+
+
+def _layernorm(sd, key):
+    return {"scale": _np(sd[key + ".weight"]), "bias": _np(sd[key + ".bias"])}
+
+
+def convert_vit(sd: Mapping[str, np.ndarray], depth: int = 12, num_heads: int = 12) -> Dict:
+    """Convert a timm `vit_base_patch16_224` state_dict to flax ViT params.
+
+    The fused qkv Linear `[3D, D]` splits into flax attention's per-projection
+    DenseGeneral kernels `[D, heads, head_dim]` (head-major, matching timm's
+    `reshape(B,N,3,heads,hd)`); `attn.proj` becomes the `[heads, head_dim, D]`
+    output kernel.
+    """
+    dim = _np(sd["cls_token"]).shape[-1]
+    hd = dim // num_heads
+    params: Dict = {
+        "cls_token": _np(sd["cls_token"]),
+        "pos_embed": _np(sd["pos_embed"]),
+        "patch_embed": {
+            "kernel": _conv_kernel(sd["patch_embed.proj.weight"]),
+            "bias": _np(sd["patch_embed.proj.bias"]),
+        },
+        "norm": _layernorm(sd, "norm"),
+        "head": _dense(sd, "head"),
+    }
+    for i in range(depth):
+        src = f"blocks.{i}."
+        qkv_w = _np(sd[src + "attn.qkv.weight"])  # [3D, D]
+        qkv_b = _np(sd[src + "attn.qkv.bias"])
+        attn = {}
+        for j, name in enumerate(("query", "key", "value")):
+            attn[name] = {
+                "kernel": qkv_w[j * dim:(j + 1) * dim].T.reshape(dim, num_heads, hd),
+                "bias": qkv_b[j * dim:(j + 1) * dim].reshape(num_heads, hd),
+            }
+        attn["out"] = {
+            "kernel": _np(sd[src + "attn.proj.weight"]).T.reshape(num_heads, hd, dim),
+            "bias": _np(sd[src + "attn.proj.bias"]),
+        }
+        params[f"block{i}"] = {
+            "norm1": _layernorm(sd, src + "norm1"),
+            "attn": attn,
+            "norm2": _layernorm(sd, src + "norm2"),
+            "mlp_fc1": _dense(sd, src + "mlp.fc1"),
+            "mlp_fc2": _dense(sd, src + "mlp.fc2"),
+        }
+    return {"params": params}
+
+
+def convert_resmlp(sd: Mapping[str, np.ndarray], depth: int = 24) -> Dict:
+    """Convert a timm `resmlp_24_distilled_224` state_dict to flax ResMLP params."""
+
+    def affine(key):
+        return {"alpha": _np(sd[key + ".alpha"]), "beta": _np(sd[key + ".beta"])}
+
+    params: Dict = {
+        "patch_embed": {
+            "kernel": _conv_kernel(sd["patch_embed.proj.weight"]),
+            "bias": _np(sd["patch_embed.proj.bias"]),
+        },
+        "norm": affine("norm"),
+        "head": _dense(sd, "head"),
+    }
+    for i in range(depth):
+        src = f"blocks.{i}."
+        params[f"block{i}"] = {
+            "ls1": _np(sd[src + "ls1"]),
+            "ls2": _np(sd[src + "ls2"]),
+            "norm1": affine(src + "norm1"),
+            "linear_tokens": _dense(sd, src + "linear_tokens"),
+            "norm2": affine(src + "norm2"),
+            "mlp_fc1": _dense(sd, src + "mlp_channels.fc1"),
+            "mlp_fc2": _dense(sd, src + "mlp_channels.fc2"),
+        }
+    return {"params": params}

@@ -1,0 +1,125 @@
+"""Victim-model factory (the reference's model layer, `/root/reference/utils.py:47-78`).
+
+Resolves an architecture name by substring against the supported timm model
+names (as the reference does), loads + converts the PatchCleanser checkpoint
+if present, and returns a jittable apply function operating on [0,1] NHWC
+images with the mean/std=0.5 normalization folded in (the reference's
+`NormModel` wrapper).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu.config import NUM_CLASSES
+
+# Supported timm model names, matched by substring as in the reference.
+TIMM_MODELS = (
+    "resnetv2_50x1_bit_distilled",
+    "vit_base_patch16_224",
+    "resmlp_24_distilled_224",
+)
+
+
+class Victim(NamedTuple):
+    """A victim classifier: `logits = apply(params, images01)`.
+
+    `apply` expects NHWC float images in [0,1] (normalization folded in) and
+    is safe to jit/vmap/grad-through.
+    """
+
+    name: str
+    apply: Callable[[Any, jax.Array], jax.Array]
+    params: Any
+    num_classes: int
+    from_checkpoint: bool
+
+
+def resolve_arch(arch: str) -> str:
+    """Substring match against supported timm names (`utils.py:55-57`)."""
+    for tm in TIMM_MODELS:
+        if arch in tm:
+            return tm
+    raise ValueError(f"unknown architecture {arch!r}; supported: {TIMM_MODELS}")
+
+
+def checkpoint_path(model_dir: str, dataset: str, timm_name: str) -> str:
+    """The PatchCleanser-release checkpoint naming contract (`utils.py:59-61`)."""
+    return os.path.join(model_dir, dataset, f"{timm_name}_cutout2_128_{dataset}.pth")
+
+
+def _build_flax(timm_name: str, num_classes: int):
+    if timm_name == "resnetv2_50x1_bit_distilled":
+        from dorpatch_tpu.models.resnetv2 import resnetv2_50x1
+
+        return resnetv2_50x1(num_classes)
+    if timm_name == "vit_base_patch16_224":
+        from dorpatch_tpu.models.vit import vit_base_patch16
+
+        return vit_base_patch16(num_classes)
+    if timm_name == "resmlp_24_distilled_224":
+        from dorpatch_tpu.models.resmlp import resmlp_24
+
+        return resmlp_24(num_classes)
+    raise NotImplementedError(timm_name)
+
+
+def _convert(timm_name: str, state_dict):
+    if timm_name == "resnetv2_50x1_bit_distilled":
+        from dorpatch_tpu.models.convert import convert_resnetv2
+
+        return convert_resnetv2(state_dict)
+    if timm_name == "vit_base_patch16_224":
+        from dorpatch_tpu.models.convert import convert_vit
+
+        return convert_vit(state_dict)
+    if timm_name == "resmlp_24_distilled_224":
+        from dorpatch_tpu.models.convert import convert_resmlp
+
+        return convert_resmlp(state_dict)
+    raise NotImplementedError(timm_name)
+
+
+def get_model(
+    dataset: str,
+    arch: str = "resnetv2",
+    model_dir: str = "pretrained_models/",
+    img_size: int = 224,
+    seed: int = 0,
+) -> Victim:
+    """Build the victim for a dataset (`utils.py:47-63` + `NormModel`).
+
+    Loads + converts `<model_dir>/<dataset>/<timm>_cutout2_128_<dataset>.pth`
+    when present; otherwise falls back to deterministic random initialization
+    (for environments without the PatchCleanser checkpoints — synthetic mode,
+    tests, benchmarks).
+    """
+    timm_name = resolve_arch(arch)
+    num_classes = NUM_CLASSES[dataset]
+    model = _build_flax(timm_name, num_classes)
+
+    ckpt = checkpoint_path(model_dir, dataset, timm_name)
+    if os.path.exists(ckpt):
+        from dorpatch_tpu.models.convert import load_state_dict
+
+        params = _convert(timm_name, load_state_dict(ckpt))
+        from_checkpoint = True
+    else:
+        dummy = jnp.zeros((1, img_size, img_size, 3), jnp.float32)
+        params = model.init(jax.random.PRNGKey(seed), dummy)
+        from_checkpoint = False
+
+    def apply(params, images01):
+        return model.apply(params, (images01 - 0.5) / 0.5)
+
+    return Victim(
+        name=timm_name,
+        apply=apply,
+        params=params,
+        num_classes=num_classes,
+        from_checkpoint=from_checkpoint,
+    )

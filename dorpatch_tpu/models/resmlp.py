@@ -1,0 +1,74 @@
+"""Flax ResMLP-24, NHWC, matching timm's `resmlp_24_distilled_224`.
+
+Third victim family of the reference (`/root/reference/utils.py:51-52`).
+timm contract (mlp_mixer.py ResBlock): 16x16 conv patch embed -> 196 tokens
+of dim 384; 24 residual blocks of [Affine norm -> token-mixing Linear(196,196)
+on the transposed sequence -> layerscale] and [Affine norm -> channel MLP
+(ratio 4, exact GELU) -> layerscale]; final Affine; mean pool; linear head.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Affine(nn.Module):
+    """Per-channel scale+shift (timm's Affine: alpha*x + beta)."""
+
+    dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        alpha = self.param("alpha", nn.initializers.ones, (self.dim,), jnp.float32)
+        beta = self.param("beta", nn.initializers.zeros, (self.dim,), jnp.float32)
+        return alpha * x + beta
+
+
+class ResMLPBlock(nn.Module):
+    dim: int
+    seq_len: int
+    mlp_ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        ls1 = self.param("ls1", nn.initializers.ones, (self.dim,), jnp.float32)
+        ls2 = self.param("ls2", nn.initializers.ones, (self.dim,), jnp.float32)
+        y = Affine(self.dim, name="norm1")(x)
+        y = nn.Dense(self.seq_len, name="linear_tokens")(y.transpose(0, 2, 1))
+        x = x + ls1 * y.transpose(0, 2, 1)
+        y = Affine(self.dim, name="norm2")(x)
+        y = nn.Dense(self.dim * self.mlp_ratio, name="mlp_fc1")(y)
+        y = nn.gelu(y, approximate=False)
+        y = nn.Dense(self.dim, name="mlp_fc2")(y)
+        return x + ls2 * y
+
+
+class ResMLP(nn.Module):
+    num_classes: int
+    patch_size: int = 16
+    dim: int = 384
+    depth: int = 24
+    img_size: int = 224
+
+    @nn.compact
+    def __call__(self, x):
+        B = x.shape[0]
+        x = nn.Conv(
+            self.dim,
+            (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            padding="VALID",
+            name="patch_embed",
+        )(x)
+        x = x.reshape(B, -1, self.dim)
+        seq_len = x.shape[1]
+        for i in range(self.depth):
+            x = ResMLPBlock(self.dim, seq_len, name=f"block{i}")(x)
+        x = Affine(self.dim, name="norm")(x)
+        x = x.mean(axis=1)
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+def resmlp_24(num_classes: int) -> ResMLP:
+    return ResMLP(num_classes=num_classes)

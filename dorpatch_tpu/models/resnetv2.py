@@ -1,0 +1,129 @@
+"""Flax ResNetV2-50x1 (BiT), NHWC, matching timm's `resnetv2_50x1_bit_distilled`.
+
+This is the victim model the reference loads via timm
+(`/root/reference/utils.py:47-63`). Architectural contract (timm resnetv2.py,
+BiT variant):
+
+- Weight-standardized convs (`StdConv2dSame`, eps=1e-8): per-output-channel
+  (w - mean) / sqrt(biased_var + eps), TF-style dynamic SAME padding.
+- Pre-activation bottlenecks with GroupNorm(32, eps=1e-5) + ReLU; the
+  projection shortcut consumes the *pre-activated* input.
+- "Fixed" stem: 7x7/2 std-conv (SAME), then ConstantPad2d(1, value=0) +
+  3x3/2 VALID max-pool. The zero-valued pad (not -inf) is a timm quirk that
+  must be reproduced exactly for checkpoint parity.
+- Head: final GroupNorm+ReLU, global average pool, 1x1 conv classifier
+  (converted here to a Dense).
+
+Everything is NHWC and bfloat16-friendly; the MXU-heavy ops are the convs,
+which XLA tiles directly.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class StdConv(nn.Module):
+    """Weight-standardized conv, TF SAME padding (timm StdConv2dSame, eps=1e-8)."""
+
+    features: int
+    kernel_size: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    eps: float = 1e-8
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            nn.initializers.he_normal(),
+            (*self.kernel_size, x.shape[-1], self.features),
+            jnp.float32,
+        )
+        mean = jnp.mean(kernel, axis=(0, 1, 2), keepdims=True)
+        var = jnp.var(kernel, axis=(0, 1, 2), keepdims=True)
+        kernel = (kernel - mean) * jax.lax.rsqrt(var + self.eps)
+        return jax.lax.conv_general_dilated(
+            x,
+            kernel.astype(x.dtype),
+            window_strides=self.strides,
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
+class GroupNormRelu(nn.Module):
+    """GroupNorm(32, eps=1e-5) + ReLU (timm GroupNormAct)."""
+
+    num_groups: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.GroupNorm(num_groups=self.num_groups, epsilon=1e-5, dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+class PreActBottleneck(nn.Module):
+    """Pre-activation bottleneck: GN/ReLU -> 1x1 -> GN/ReLU -> 3x3(stride)
+    -> GN/ReLU -> 1x1, with the projection shortcut taken from the
+    pre-activated input (timm PreActBottleneck)."""
+
+    out_features: int
+    stride: int = 1
+    bottle_ratio: float = 0.25
+
+    @nn.compact
+    def __call__(self, x):
+        mid = int(round(self.out_features * self.bottle_ratio))
+        preact = GroupNormRelu(name="norm1")(x)
+        if x.shape[-1] != self.out_features or self.stride != 1:
+            shortcut = StdConv(
+                self.out_features, (1, 1), (self.stride, self.stride), name="downsample_conv"
+            )(preact)
+        else:
+            shortcut = x
+        y = StdConv(mid, (1, 1), name="conv1")(preact)
+        y = GroupNormRelu(name="norm2")(y)
+        y = StdConv(mid, (3, 3), (self.stride, self.stride), name="conv2")(y)
+        y = GroupNormRelu(name="norm3")(y)
+        y = StdConv(self.out_features, (1, 1), name="conv3")(y)
+        return y + shortcut
+
+
+class ResNetV2(nn.Module):
+    """BiT ResNetV2 trunk. Defaults = 50x1 (layers 3-4-6-3, width 1)."""
+
+    num_classes: int
+    layers: Sequence[int] = (3, 4, 6, 3)
+    width_factor: int = 1
+    stem_features: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        wf = self.width_factor
+        x = StdConv(self.stem_features * wf, (7, 7), (2, 2), name="stem_conv")(x)
+        # timm "fixed" stem pool: ConstantPad2d(1, 0.) then VALID 3x3/2 pool.
+        # Zero pad (not -inf) is deliberate — see module docstring.
+        x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        features = 256
+        for si, depth in enumerate(self.layers):
+            for bi in range(depth):
+                stride = 2 if (bi == 0 and si > 0) else 1
+                x = PreActBottleneck(
+                    features * wf, stride=stride, name=f"stage{si}_block{bi}"
+                )(x)
+            features *= 2
+
+        x = GroupNormRelu(name="norm")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, name="head")(x)
+        return x
+
+
+def resnetv2_50x1(num_classes: int) -> ResNetV2:
+    return ResNetV2(num_classes=num_classes)

@@ -1,0 +1,50 @@
+"""Small CIFAR victim (ResNet-18 style) for the sweep benchmark config.
+
+Used by BASELINE.md Config 4 (CIFAR-10 patch-size x sparsity sweep). This is
+our own model (no timm-checkpoint contract): a CIFAR-style ResNet-18 with a
+3x3 stem, GroupNorm instead of BatchNorm (no mutable batch stats inside the
+jitted attack loop), NHWC.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Conv(self.features, (3, 3), (self.stride, self.stride), padding=1,
+                    use_bias=False, name="conv1")(x)
+        y = nn.relu(nn.GroupNorm(num_groups=8, name="norm1")(y))
+        y = nn.Conv(self.features, (3, 3), padding=1, use_bias=False, name="conv2")(y)
+        y = nn.GroupNorm(num_groups=8, name="norm2")(y)
+        if x.shape[-1] != self.features or self.stride != 1:
+            x = nn.Conv(self.features, (1, 1), (self.stride, self.stride),
+                        use_bias=False, name="proj")(x)
+            x = nn.GroupNorm(num_groups=8, name="proj_norm")(x)
+        return nn.relu(x + y)
+
+
+class CifarResNet18(nn.Module):
+    num_classes: int = 10
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(64, (3, 3), padding=1, use_bias=False, name="stem")(x)
+        x = nn.relu(nn.GroupNorm(num_groups=8, name="stem_norm")(x))
+        features = 64
+        for si, depth in enumerate(self.stage_sizes):
+            for bi in range(depth):
+                stride = 2 if (bi == 0 and si > 0) else 1
+                x = BasicBlock(features, stride, name=f"stage{si}_block{bi}")(x)
+            features *= 2
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="head")(x)

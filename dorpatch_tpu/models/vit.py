@@ -1,0 +1,79 @@
+"""Flax ViT-B/16, NHWC, matching timm's `vit_base_patch16_224`.
+
+Second victim family of the reference (`/root/reference/utils.py:51-52`).
+timm contract: 16x16 conv patch embed (with bias), cls token, learned
+197-token position embedding added after cls concat, pre-norm transformer
+blocks (LayerNorm eps=1e-6, 12 heads, qkv bias, MLP ratio 4 with *exact*
+erf GELU — torch nn.GELU default), final LayerNorm, linear head on the cls
+token.
+
+TPU notes: attention is batched matmuls on the MXU; sequence length 197 is
+small, so no flash/ring attention is needed here — the EOT/mask axis is this
+workload's scaling dimension (SURVEY.md §5) and is sharded at the batch level.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ViTBlock(nn.Module):
+    dim: int
+    num_heads: int
+    mlp_ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(epsilon=1e-6, name="norm1")(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            qkv_features=self.dim,
+            out_features=self.dim,
+            use_bias=True,
+            name="attn",
+        )(y, y)
+        x = x + y
+        y = nn.LayerNorm(epsilon=1e-6, name="norm2")(x)
+        y = nn.Dense(self.dim * self.mlp_ratio, name="mlp_fc1")(y)
+        y = nn.gelu(y, approximate=False)
+        y = nn.Dense(self.dim, name="mlp_fc2")(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    num_classes: int
+    patch_size: int = 16
+    dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    img_size: Tuple[int, int] = (224, 224)
+
+    @nn.compact
+    def __call__(self, x):
+        B = x.shape[0]
+        x = nn.Conv(
+            self.dim,
+            (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            padding="VALID",
+            name="patch_embed",
+        )(x)
+        x = x.reshape(B, -1, self.dim)  # [B, 196, D] row-major patches
+        cls = self.param("cls_token", nn.initializers.zeros, (1, 1, self.dim), jnp.float32)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, self.dim)), x], axis=1)
+        n_tokens = x.shape[1]
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, n_tokens, self.dim), jnp.float32
+        )
+        x = x + pos
+        for i in range(self.depth):
+            x = ViTBlock(self.dim, self.num_heads, name=f"block{i}")(x)
+        x = nn.LayerNorm(epsilon=1e-6, name="norm")(x)
+        return nn.Dense(self.num_classes, name="head")(x[:, 0])
+
+
+def vit_base_patch16(num_classes: int) -> ViT:
+    return ViT(num_classes=num_classes)

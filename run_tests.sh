@@ -3,4 +3,5 @@
 # plugin registration (which claims the exclusive device grant and can block
 # behind any other live JAX process); tests run on an 8-device virtual CPU
 # mesh regardless (tests/conftest.py).
+cd "$(dirname "$0")"
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"

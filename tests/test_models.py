@@ -1,0 +1,163 @@
+"""Model parity tests: flax ResNetV2 vs the torch oracle via checkpoint
+conversion (SURVEY.md §4 parity strategy; logits must agree to ~1e-4)."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu.backends.torch_models import ResNetV2Torch, Normalized, create_torch_model
+from dorpatch_tpu.models.convert import convert_resnetv2
+from dorpatch_tpu.models.resnetv2 import ResNetV2
+
+
+@pytest.fixture(scope="module")
+def small_pair():
+    """Torch oracle (random weights) + converted flax params, tiny config."""
+    torch.manual_seed(0)
+    tm = ResNetV2Torch(num_classes=7, layers=(1, 1), width=1).eval()
+    sd = {k: v for k, v in tm.state_dict().items()}
+    params = convert_resnetv2(sd, layers=(1, 1))
+    fm = ResNetV2(num_classes=7, layers=(1, 1))
+    return tm, fm, params
+
+
+def _logits_pair(tm, fm, params, x_nchw):
+    with torch.no_grad():
+        want = tm(torch.tensor(x_nchw)).numpy()
+    got = np.asarray(fm.apply(params, jnp.asarray(x_nchw.transpose(0, 2, 3, 1))))
+    return got, want
+
+
+def test_resnetv2_parity_small(small_pair):
+    tm, fm, params = small_pair
+    x = np.random.default_rng(1).normal(size=(2, 3, 64, 64)).astype(np.float32)
+    got, want = _logits_pair(tm, fm, params, x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_resnetv2_parity_odd_size(small_pair):
+    """Odd spatial size exercises the asymmetric TF-SAME padding paths."""
+    tm, fm, params = small_pair
+    x = np.random.default_rng(2).normal(size=(1, 3, 57, 57)).astype(np.float32)
+    got, want = _logits_pair(tm, fm, params, x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_resnetv2_50_parity_full():
+    """Full 50-layer config at 224px (the real victim geometry)."""
+    torch.manual_seed(0)
+    tm = ResNetV2Torch(num_classes=10).eval()
+    params = convert_resnetv2(tm.state_dict())
+    fm = ResNetV2(num_classes=10)
+    x = np.random.default_rng(3).normal(size=(1, 3, 224, 224)).astype(np.float32) * 0.5 + 0.5
+    got, want = _logits_pair(tm, fm, params, x)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=2e-4)
+
+
+def test_normalized_wrapper_matches_manual(small_pair):
+    tm, fm, params = small_pair
+    x01 = np.random.default_rng(4).uniform(size=(1, 3, 64, 64)).astype(np.float32)
+    with torch.no_grad():
+        want = Normalized(tm)(torch.tensor(x01)).numpy()
+        manual = tm(torch.tensor((x01 - 0.5) / 0.5)).numpy()
+    np.testing.assert_allclose(want, manual, rtol=1e-6)
+    got = np.asarray(
+        fm.apply(params, (jnp.asarray(x01.transpose(0, 2, 3, 1)) - 0.5) / 0.5))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_state_dict_keys_are_timm_shaped():
+    """Checkpoint-compat contract: keys look like timm resnetv2 keys."""
+    tm = ResNetV2Torch(num_classes=5, layers=(1, 1))
+    keys = set(tm.state_dict().keys())
+    assert "stem.conv.weight" in keys
+    assert "stages.0.blocks.0.norm1.weight" in keys
+    assert "stages.0.blocks.0.conv2.weight" in keys
+    assert "stages.0.blocks.0.downsample.conv.weight" in keys
+    assert "stages.1.blocks.0.downsample.conv.weight" in keys
+    assert "norm.weight" in keys and "norm.bias" in keys
+    assert "head.fc.weight" in keys and "head.fc.bias" in keys
+    # no extra buffers / unexpected params
+    assert all(".num_batches_tracked" not in k for k in keys)
+
+
+def test_factory_rejects_unknown_arch():
+    from dorpatch_tpu.models import resolve_arch
+
+    assert resolve_arch("resnetv2") == "resnetv2_50x1_bit_distilled"
+    assert resolve_arch("vit") == "vit_base_patch16_224"
+    with pytest.raises(ValueError):
+        resolve_arch("densenet")
+
+
+def test_grads_flow_through_flax_model(small_pair):
+    _, fm, params = small_pair
+    x = jnp.ones((1, 64, 64, 3)) * 0.3
+
+    def loss(x):
+        return fm.apply(params, x).sum()
+
+    g = jax.grad(loss)(x)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).sum()) > 0
+
+
+# ---------- ViT / ResMLP parity ----------
+
+def test_vit_parity_tiny():
+    from dorpatch_tpu.backends.torch_models import ViTTorch
+    from dorpatch_tpu.models.convert import convert_vit
+    from dorpatch_tpu.models.vit import ViT
+
+    torch.manual_seed(1)
+    tm = ViTTorch(num_classes=5, dim=32, depth=2, heads=4, patch=8, img=32).eval()
+    params = convert_vit(tm.state_dict(), depth=2, num_heads=4)
+    fm = ViT(num_classes=5, patch_size=8, dim=32, depth=2, num_heads=4, img_size=(32, 32))
+    x = np.random.default_rng(5).normal(size=(2, 3, 32, 32)).astype(np.float32)
+    got, want = _logits_pair(tm, fm, params, x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_vit_base_parity_full():
+    from dorpatch_tpu.backends.torch_models import ViTTorch
+    from dorpatch_tpu.models.convert import convert_vit
+    from dorpatch_tpu.models.vit import ViT
+
+    torch.manual_seed(2)
+    tm = ViTTorch(num_classes=10).eval()
+    params = convert_vit(tm.state_dict())
+    fm = ViT(num_classes=10)
+    x = np.random.default_rng(6).normal(size=(1, 3, 224, 224)).astype(np.float32)
+    got, want = _logits_pair(tm, fm, params, x)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=5e-4)
+
+
+def test_resmlp_parity_tiny():
+    from dorpatch_tpu.backends.torch_models import ResMLPTorch
+    from dorpatch_tpu.models.convert import convert_resmlp
+    from dorpatch_tpu.models.resmlp import ResMLP
+
+    torch.manual_seed(3)
+    tm = ResMLPTorch(num_classes=5, dim=48, depth=3, patch=8, img=32).eval()
+    params = convert_resmlp(tm.state_dict(), depth=3)
+    fm = ResMLP(num_classes=5, patch_size=8, dim=48, depth=3, img_size=32)
+    x = np.random.default_rng(7).normal(size=(2, 3, 32, 32)).astype(np.float32)
+    got, want = _logits_pair(tm, fm, params, x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_cifar_resnet18_forward_and_grad():
+    from dorpatch_tpu.models.small import CifarResNet18
+
+    m = CifarResNet18()
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    x = jnp.ones((2, 32, 32, 3)) * 0.5
+    logits = m.apply(params, x)
+    assert logits.shape == (2, 10)
+    g = jax.grad(lambda x: m.apply(params, x).sum())(x)
+    assert np.isfinite(np.asarray(g)).all()
