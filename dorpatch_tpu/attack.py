@@ -1,0 +1,529 @@
+"""The DorPatch optimizer as jitted XLA programs with on-device carry state.
+
+Reimplements the reference's two-stage attack (`/root/reference/attack.py:51-406`)
+TPU-first. The reference's hot loop interleaves CUDA compute with host-side
+numpy bookkeeping every iteration (best-checkpointing, failure-set surgery,
+lr/coefficient schedules — `attack.py:249-342`); here the *entire* adaptive
+state lives in a `TrainState` pytree on device, one optimization step is a
+single fused jit program (sample masks -> rasterize -> masked forward -> CW +
+TV + density + group-lasso losses -> signed-grad update -> bookkeeping as
+`where` selects), and steps run in `lax.scan` blocks of `sweep_interval`
+between full-universe failure sweeps. Host work per block: one scalar sync.
+
+Stage 0 learns a continuous importance map under group-lasso/density
+regularization; stage 1 freezes the top-k hard mask (`patch_selection`) and
+refines the pattern under EOT over the occlusion universe.
+
+Deliberate repairs of reference latent bugs (SURVEY.md §4), preserved in
+spirit but made well-defined:
+- true batched semantics over B images (the reference hard-assumes B=1:
+  `attack.py:98,120,313,344`);
+- the iteration-500 switch keeps per-image targets; images with no
+  misclassified EOT sample keep their label (the reference would set an
+  inconsistent targeted-toward-truth state, `attack.py:106-122,169-176`);
+- the redundant second sweep at the switch iteration is dropped (the
+  reference's `attack.py:181` result is immediately overwritten at
+  `attack.py:189`);
+- `dual=False` dead branch (`attack.py:208-218`) is a live config option.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dorpatch_tpu import losses
+from dorpatch_tpu import masks as masks_lib
+from dorpatch_tpu.config import AttackConfig
+from dorpatch_tpu.defense import masked_predictions
+
+
+class TrainState(NamedTuple):
+    """Everything the reference keeps on the host (`attack.py:59-98,129-132`),
+    as a single on-device pytree."""
+
+    step: jax.Array            # i32 scalar, iteration within the stage
+    rng: jax.Array             # PRNG key
+    adv_mask: jax.Array        # [B,H,W,1] continuous (stage 0) / frozen hard (stage 1)
+    adv_pattern: jax.Array     # [B,H,W,3]
+    best_mask: jax.Array       # [B,H,W,1]
+    best_pattern: jax.Array    # [B,H,W,3]
+    loss_best: jax.Array       # [B] best target-loss so far (inf = none)
+    lr: jax.Array              # [B] per-image signed-grad step size
+    not_decay: jax.Array       # [B] i32 patience counters
+    num_failure: jax.Array     # i32 scalar, failure count of the best checkpoint
+    failed: jax.Array          # [n_mask] bool, current failure set
+    coeff_gl: jax.Array        # f32 scalar, adaptive group-lasso coefficient
+    coeff_struct: jax.Array    # f32 scalar, adaptive structural coefficient
+    targeted: jax.Array        # [B] bool, per-image attack mode
+    y: jax.Array               # [B] labels (ground truth or targets)
+    last_preds: jax.Array      # [B,S] predictions of the last sampled forward
+    stopped: jax.Array         # bool scalar, all-lr early stop latched
+    metrics: jax.Array         # [8] f32: loss, adv, struc, gl, density, acc, l2, n_failed
+
+
+class AttackResult(NamedTuple):
+    adv_mask: jax.Array        # [B,H,W,1]
+    adv_pattern: jax.Array     # [B,H,W,3]
+    y: np.ndarray              # [B] final labels (targets if switched)
+    targeted: np.ndarray       # [B] bool, per-image mode after switching
+    stage0_mask: jax.Array
+    stage0_pattern: jax.Array
+
+
+def patch_selection(
+    mask: jax.Array, patch_budget: float, basic_unit: int = 7
+) -> jax.Array:
+    """Importance map -> hard patch mask (`/root/reference/attack.py:363-382`).
+
+    Window-sum the continuous mask over basic_unit cells, take the top
+    `floor(H*W*budget/unit^2)` cells with positive mass, upsample to pixels.
+    mask `[B,H,W,1]` -> binary `[B,H,W,1]`.
+    """
+    b, h, w, _ = mask.shape
+    cells = losses.window_sum(mask, basic_unit)[..., 0]  # [B, h', w']
+    hp, wp = cells.shape[1:]
+    flat = cells.reshape(b, -1)
+    k = int(np.floor(h * w * patch_budget / basic_unit**2))
+    vals, idxs = jax.lax.top_k(flat, k)
+    sel = jnp.zeros_like(flat)
+    updates = (vals > 0).astype(mask.dtype)
+    sel = jax.vmap(lambda s, i, u: s.at[i].set(u))(sel, idxs, updates)
+    sel = sel.reshape(b, hp, wp)
+    sel = jnp.repeat(jnp.repeat(sel, basic_unit, axis=1), basic_unit, axis=2)
+    return sel[..., None]
+
+
+def majority_incorrect_label(preds: jax.Array, y: jax.Array, num_classes: int):
+    """Per-image mode of misclassified predictions (`attack.py:106-122`):
+    the easiest target for label-consistent certification evasion.
+
+    preds `[B,S]`, y `[B]`. Returns `(labels, has_target)`: images with no
+    misclassified prediction keep their label and report False — they must
+    *stay untargeted* (the reference would flip its global flag and start
+    optimizing toward the true label for them; see module docstring)."""
+    incorrect = preds != y[:, None]
+    counts = jnp.sum(
+        jax.nn.one_hot(preds, num_classes, dtype=jnp.int32) * incorrect[..., None], axis=1
+    )  # [B, C]
+    has_any = jnp.any(incorrect, axis=1)
+    mode = jnp.argmax(counts, axis=-1).astype(y.dtype)  # smallest label on ties
+    return jnp.where(has_any, mode, y), has_any
+
+
+@dataclasses.dataclass
+class DorPatch:
+    """Two-stage distributed occlusion-robust patch attack
+    (`/root/reference/attack.py:51-361`), jitted end-to-end per stage."""
+
+    apply_fn: Callable[[Any, jax.Array], jax.Array]
+    params: Any
+    num_classes: int
+    config: AttackConfig = dataclasses.field(default_factory=AttackConfig)
+    remat: bool = True
+    on_block_end: Optional[Callable[[int, int, dict], None]] = None
+
+    def __post_init__(self):
+        cfg = self.config
+        fwd = self.apply_fn
+        if self.remat:
+            fwd = jax.checkpoint(fwd)
+        self._fwd = fwd
+        self._sampling_size = cfg.sampling_size
+        self._block_fns = {}
+        self._sweep_fn = None
+
+    # ---------- mask sampling (static shapes) ----------
+
+    def _sample_indices(self, rng, failed, step):
+        """Failure-biased EOT sampling (`attack.py:192-204`) with static
+        shapes: up to half the batch from the failure set (after
+        `failure_sampling_start`), the rest uniform from the universe, both
+        without replacement within their draw, via Gumbel top-k."""
+        cfg = self.config
+        n_mask = failed.shape[0]
+        # the reference clamps the EOT batch to the universe size
+        # (`attack.py:92-94`); also keeps the dropout=0 identity universe legal
+        s = min(self._sampling_size, n_mask)
+        half = s // 2
+        k1, k2 = jax.random.split(rng)
+
+        g_uni = jax.random.gumbel(k2, (n_mask,))
+        uni_top = jax.lax.top_k(g_uni, s)[1]
+        pos = jnp.arange(s)
+        if half == 0:
+            return uni_top, jnp.zeros((s,), bool)
+
+        n_failed = jnp.sum(failed.astype(jnp.int32))
+        n_from_fail = jnp.where(
+            step >= cfg.failure_sampling_start, jnp.minimum(n_failed, half), 0
+        )
+        g_fail = jax.random.gumbel(k1, (n_mask,)) + jnp.where(failed, 0.0, -jnp.inf)
+        fail_top = jax.lax.top_k(g_fail, half)[1]
+
+        from_fail = pos < n_from_fail
+        idx = jnp.where(
+            from_fail,
+            fail_top[jnp.clip(pos, 0, half - 1)],
+            uni_top[jnp.clip(pos - n_from_fail, 0, s - 1)],
+        )
+        return idx, from_fail
+
+    # ---------- one optimization step ----------
+
+    def _loss_and_aux(self, adv_mask, adv_pattern, x, local_var_x, mask_imgs, state, stage):
+        cfg = self.config
+        b = x.shape[0]
+        s = mask_imgs.shape[0]  # effective EOT batch (clamped to universe size)
+        delta = losses.l2_project(adv_mask, adv_pattern, x, cfg.eps)
+        adv_x = x + delta
+        masked = masks_lib.apply_masks(adv_x, mask_imgs, cfg.mask_fill)
+        logits = self._fwd(self.params, masked.reshape((-1,) + x.shape[1:]))
+        y_rep = jnp.repeat(state.y, s)
+        targeted_rep = jnp.repeat(state.targeted, s)
+        loss_adv = losses.cw_margin_switchable(
+            logits, y_rep, self.num_classes, targeted_rep, cfg.confidence
+        ).reshape(b, s)
+
+        loss_struc = losses.structural_loss(adv_x, local_var_x)
+        loss = jnp.mean(loss_adv, axis=1)
+        if cfg.structured != 0:
+            loss = loss + state.coeff_struct * loss_struc
+        gl = jnp.zeros(b)
+        dens = jnp.zeros(b)
+        if stage == 0:
+            dens = losses.density_loss(adv_mask, x.shape[1] // 8)
+            if cfg.density != 0:
+                loss = loss + cfg.density * dens
+            gl = losses.group_lasso(adv_mask, cfg.basic_unit)
+            loss = loss + state.coeff_gl * gl
+        preds = jnp.argmax(logits, axis=-1).reshape(b, s)
+        aux = dict(
+            loss=loss, loss_adv=loss_adv, loss_struc=loss_struc,
+            group_lasso=gl, density=dens, preds=preds, delta=delta,
+        )
+        return jnp.sum(loss), aux
+
+    def _step(self, state: TrainState, x, local_var_x, universe, stage: int) -> TrainState:
+        cfg = self.config
+        b = x.shape[0]
+        rng, k_samp, k_dual = jax.random.split(state.rng, 3)
+
+        idx, from_fail = self._sample_indices(k_samp, state.failed, state.step)
+        mask_imgs = masks_lib.rasterize(universe[idx], x.shape[1]).astype(x.dtype)
+        if cfg.dual:
+            idx2, _ = self._sample_indices(k_dual, state.failed, state.step)
+            mask_imgs = mask_imgs * masks_lib.rasterize(
+                universe[idx2], x.shape[1]
+            ).astype(x.dtype)
+
+        grad_fn = jax.grad(self._loss_and_aux, argnums=(0, 1), has_aux=True)
+        (g_mask, g_pattern), aux = grad_fn(
+            state.adv_mask, state.adv_pattern, x, local_var_x, mask_imgs, state, stage
+        )
+
+        # ---- bookkeeping (`attack.py:249-342`), all as selects ----
+        loss_adv = aux["loss_adv"]
+        attack_success_bs = loss_adv < cfg.success_threshold     # [B,S]
+        mask_success = jnp.all(attack_success_bs, axis=0)        # [S]
+
+        # failure-set surgery (`attack.py:259-267`): successes drawn from the
+        # failure set leave it; failures drawn from the universe enter it.
+        # Non-matching positions scatter to an out-of-bounds marker + drop.
+        n_mask = state.failed.shape[0]
+        remove = jnp.where(from_fail & mask_success, idx, n_mask)
+        add = jnp.where((~from_fail) & (~mask_success), idx, n_mask)
+        failed = state.failed.at[remove].set(False, mode="drop")
+        failed = failed.at[add].set(True, mode="drop")
+        n_failed = jnp.sum(failed.astype(jnp.int32))
+
+        attack_success = jnp.all(attack_success_bs)              # scalar (all B, all S)
+        certifiable = n_failed == 0
+
+        loss_target = aux["group_lasso"] if stage == 0 else aux["loss_struc"]
+        loss_best = jnp.where(n_failed < state.num_failure, jnp.inf, state.loss_best)
+        certify_better = n_failed <= state.num_failure
+        loss_decay = certify_better & ((loss_target - loss_best) < -cfg.loss_decay_margin)
+
+        any_save = jnp.any(loss_decay)
+        num_failure = jnp.where(any_save, n_failed, state.num_failure)
+        loss_best = jnp.where(loss_decay, loss_target, loss_best)
+        sel = loss_decay[:, None, None, None]
+        best_mask = jnp.where(sel, state.adv_mask, state.best_mask) if stage == 0 else state.best_mask
+        best_pattern = jnp.where(sel, state.adv_pattern, state.best_pattern)
+        not_decay = jnp.where(loss_decay, 0, state.not_decay + 1)
+
+        # adaptive coefficient schedule (`attack.py:294-303`): stage 0 past
+        # adapt_start scales the group-lasso coefficient, every other step
+        # (including early stage 0) scales the structural coefficient.
+        grow = attack_success & certifiable
+        factor = jnp.where(grow, cfg.scale_up, 1.0 / cfg.scale_down)
+        if stage == 0:
+            gl_adapts = state.step > cfg.adapt_start
+        else:
+            gl_adapts = jnp.asarray(False)
+        coeff_gl = jnp.where(gl_adapts, state.coeff_gl * factor, state.coeff_gl)
+        coeff_struct = jnp.where(gl_adapts, state.coeff_struct, state.coeff_struct * factor)
+
+        # patience lr decay (`attack.py:292,305-316`)
+        early = not_decay > cfg.patience
+        lr = jnp.where(early, state.lr * cfg.lr_decay, state.lr)
+        lr = jnp.maximum(lr, cfg.lr_floor)
+        not_decay = jnp.where(early, 0, not_decay)
+        stopped = jnp.all(lr < cfg.lr_stop)
+
+        # signed-gradient updates (`attack.py:332-342`); mask only in stage 0
+        lr_b = lr[:, None, None, None]
+        new_pattern = jnp.clip(
+            state.adv_pattern - lr_b * jnp.sign(g_pattern), cfg.clip_min, cfg.clip_max
+        )
+        if stage == 0:
+            new_mask = jnp.clip(
+                state.adv_mask - lr_b * jnp.sign(g_mask), cfg.clip_min, cfg.clip_max
+            )
+        else:
+            new_mask = state.adv_mask
+
+        acc = jnp.mean((aux["preds"] == state.y[:, None]).astype(jnp.float32))
+        l2 = jnp.sqrt(jnp.sum(aux["delta"] ** 2, axis=(1, 2, 3))).mean()
+        metrics = jnp.stack(
+            [
+                aux["loss"].mean(), loss_adv.mean(), aux["loss_struc"].mean(),
+                aux["group_lasso"].mean(), aux["density"].mean(), acc, l2,
+                n_failed.astype(jnp.float32),
+            ]
+        )
+
+        new = TrainState(
+            step=state.step + 1, rng=rng, adv_mask=new_mask, adv_pattern=new_pattern,
+            best_mask=best_mask, best_pattern=best_pattern, loss_best=loss_best,
+            lr=lr, not_decay=not_decay, num_failure=num_failure, failed=failed,
+            coeff_gl=coeff_gl, coeff_struct=coeff_struct, targeted=state.targeted,
+            y=state.y, last_preds=aux["preds"], stopped=state.stopped | stopped,
+            metrics=metrics,
+        )
+        # latched early stop: once stopped, the state passes through unchanged
+        return jax.tree_util.tree_map(
+            lambda old, upd: jnp.where(state.stopped, old, upd), state, new
+        )
+
+    # ---------- jitted block + sweep ----------
+
+    def _get_block(self, stage: int, img_size: int, n_steps: int):
+        key = (stage, img_size, n_steps)
+        if key not in self._block_fns:
+
+            @partial(jax.jit, static_argnums=())
+            def run_block(state, x, local_var_x, universe):
+                def body(s, _):
+                    return self._step(s, x, local_var_x, universe, stage), None
+
+                state, _ = jax.lax.scan(body, state, None, length=n_steps)
+                return state
+
+            self._block_fns[key] = run_block
+        return self._block_fns[key]
+
+    def sweep_failures(self, adv_mask, adv_pattern, x, y, targeted, universe) -> jax.Array:
+        """Full-universe failure sweep (`attack.py:384-406`): a mask index
+        fails if any image's goal is violated under it. Returns bool [n_mask]."""
+        if self._sweep_fn is None:
+
+            @jax.jit
+            def sweep(adv_mask, adv_pattern, x, y, targeted, universe):
+                delta = losses.l2_project(adv_mask, adv_pattern, x, self.config.eps)
+                adv_x = x + delta
+                preds = masked_predictions(
+                    self._fwd, self.params, adv_x, universe,
+                    min(self._sampling_size, universe.shape[0]),
+                    self.config.mask_fill,
+                )  # [B, n_mask]
+                hit = preds == y[:, None]
+                fail_per_img = jnp.where(targeted[:, None], ~hit, hit)
+                return jnp.any(fail_per_img, axis=0)
+
+            self._sweep_fn = sweep
+        return self._sweep_fn(adv_mask, adv_pattern, x, y, targeted, universe)
+
+    # ---------- host orchestration ----------
+
+    def _init_state(self, key, x, y, targeted, universe_size) -> TrainState:
+        cfg = self.config
+        b, h, w, _ = x.shape
+        k_mask, k_pat, k_run = jax.random.split(key, 3)
+        return TrainState(
+            step=jnp.asarray(0, jnp.int32),
+            rng=k_run,
+            adv_mask=jax.random.uniform(k_mask, (b, h, w, 1)),
+            adv_pattern=jax.random.uniform(k_pat, (b, h, w, 3)),
+            best_mask=jnp.zeros((b, h, w, 1)),
+            best_pattern=jnp.zeros((b, h, w, 3)),
+            loss_best=jnp.full((b,), jnp.inf),
+            lr=jnp.full((b,), cfg.lr),
+            not_decay=jnp.zeros((b,), jnp.int32),
+            num_failure=jnp.asarray(universe_size + 1, jnp.int32),
+            failed=jnp.zeros((universe_size,), bool),
+            coeff_gl=jnp.asarray(cfg.coeff_group_lasso, jnp.float32),
+            coeff_struct=jnp.asarray(cfg.structured, jnp.float32),
+            targeted=jnp.broadcast_to(jnp.asarray(targeted, bool), (b,)).copy(),
+            y=jnp.asarray(y, jnp.int32),
+            last_preds=jnp.zeros((b, min(self._sampling_size, universe_size)), jnp.int32),
+            stopped=jnp.asarray(False),
+            metrics=jnp.zeros((8,)),
+        )
+
+    def _reset_schedules(self, state: TrainState, universe_size: int) -> TrainState:
+        """lr/best/patience reset at the targeted switch (`attack.py:177-180`)."""
+        cfg = self.config
+        b = state.lr.shape[0]
+        return state._replace(
+            lr=jnp.full((b,), cfg.lr),
+            loss_best=jnp.full((b,), jnp.inf),
+            not_decay=jnp.zeros((b,), jnp.int32),
+            num_failure=jnp.asarray(universe_size + 1, jnp.int32),
+        )
+
+    def _finalize_best(self, state: TrainState) -> Tuple[jax.Array, jax.Array]:
+        """Images that never checkpointed fall back to their current iterate
+        (`attack.py:311-316,344-346`), per image (batched repair)."""
+        never = jnp.isinf(state.loss_best)[:, None, None, None]
+        best_mask = jnp.where(never, state.adv_mask, state.best_mask)
+        best_pattern = jnp.where(never, state.adv_pattern, state.best_pattern)
+        return best_mask, best_pattern
+
+    def _run_stage(self, stage: int, state: TrainState, x, local_var_x, universe) -> TrainState:
+        cfg = self.config
+        img_size = x.shape[1]
+        n_universe = universe.shape[0]
+        interval = cfg.sweep_interval
+        total = cfg.max_iterations
+        block = self._get_block(stage, img_size, interval)
+
+        i = 0
+        while i < total:
+            # full failure sweep at every sweep_interval boundary (incl. i=0,
+            # `attack.py:187-190`)
+            failed = self.sweep_failures(
+                state.adv_mask, state.adv_pattern, x, state.y, state.targeted, universe
+            )
+            state = state._replace(failed=failed)
+
+            n_steps = min(interval, total - i)
+            if n_steps != interval:
+                block = self._get_block(stage, img_size, n_steps)
+            state = block(state, x, local_var_x, universe)
+            i += n_steps
+
+            # untargeted -> targeted switch at the boundary after
+            # switch_iteration steps (stage 0, `attack.py:169-182`)
+            if (
+                stage == 0
+                and i >= cfg.switch_iteration
+                and i - n_steps < cfg.switch_iteration
+                and not bool(jnp.all(state.targeted))
+            ):
+                y_new, has_target = majority_incorrect_label(
+                    state.last_preds, state.y, self.num_classes
+                )
+                switch = has_target & (~state.targeted)
+                state = state._replace(
+                    targeted=state.targeted | switch,
+                    y=jnp.where(switch, y_new, state.y),
+                )
+                state = self._reset_schedules(state, n_universe)
+
+            if self.on_block_end is not None:
+                self.on_block_end(stage, i, {
+                    "metrics": np.asarray(state.metrics),
+                    "stopped": bool(state.stopped),
+                    "n_failed": int(np.asarray(state.metrics)[7]),
+                })
+            if bool(state.stopped):
+                break
+        return state
+
+    def generate(
+        self,
+        x: jax.Array,
+        y: Optional[jax.Array] = None,
+        targeted: bool = False,
+        key: Optional[jax.Array] = None,
+        store=None,
+        batch_id: int = 0,
+    ) -> AttackResult:
+        """Run the full two-stage attack on a batch of images
+        (`/root/reference/attack.py:51-361`).
+
+        x: `[B,H,W,C]` in [0,1]. y: labels (targets when `targeted`); when
+        None, the model's own predictions are used (`attack.py:67-69`).
+        `store` (optional) provides stage-0 artifact sharing across budgets:
+        `store.load_stage0(batch_id) -> (mask, pattern) | None` and
+        `store.save_stage0(batch_id, mask, pattern)`
+        (`attack.py:102-103,134-141,348-356`).
+        """
+        cfg = self.config
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        img_size = x.shape[1]
+        universe = jnp.asarray(
+            masks_lib.dropout_universe(img_size, cfg.dropout, cfg.dropout_sizes)
+        )
+        if y is None:
+            y = jnp.argmax(self.apply_fn(self.params, x), axis=-1)
+        local_var_x = jnp.mean(losses.local_variance(x)[0], axis=-1)
+
+        k0, k1 = jax.random.split(key)
+        state = self._init_state(k0, x, y, targeted, universe.shape[0])
+
+        # ---- stage 0: importance map (resumable from the shared parent dir) ----
+        cached = store.load_stage0(batch_id) if store is not None else None
+        if cached is not None:
+            stage0_mask, stage0_pattern = (jnp.asarray(cached[0]), jnp.asarray(cached[1]))
+            targeted_now = targeted
+            coeff_struct_carry = jnp.asarray(cfg.structured, jnp.float32)
+        else:
+            state = self._run_stage(0, state, x, local_var_x, universe)
+            stage0_mask, stage0_pattern = self._finalize_best(state)
+            targeted_now = state.targeted  # [B] per-image flags after stage 0
+            # the reference mutates `structured` in place, so stage 1 inherits
+            # the stage-0-adapted value (`attack.py:299-303`)
+            coeff_struct_carry = state.coeff_struct
+            if store is not None:
+                store.save_stage0(batch_id, np.asarray(stage0_mask), np.asarray(stage0_pattern))
+
+        # ---- stage 1 init (`attack.py:143-165`) ----
+        delta = losses.l2_project(stage0_mask, stage0_pattern, x, cfg.eps)
+        adv_x = x + delta
+        targeted_vec = jnp.broadcast_to(jnp.asarray(targeted_now, bool), (x.shape[0],))
+        targeted_vec = targeted_vec | state.targeted
+        preds = jnp.argmax(self.apply_fn(self.params, adv_x), axis=-1)
+        newly = (~targeted_vec) & (preds != state.y)
+        y_cur = jnp.where(newly, preds, state.y)
+        targeted_vec = targeted_vec | newly
+
+        hard_mask = patch_selection(stage0_mask, cfg.patch_budget, cfg.basic_unit)
+        state = self._init_state(k1, x, y_cur, False, universe.shape[0])
+        state = state._replace(
+            adv_mask=hard_mask,
+            adv_pattern=adv_x,
+            best_mask=hard_mask,
+            y=jnp.asarray(y_cur, jnp.int32),
+            targeted=targeted_vec,
+            coeff_struct=coeff_struct_carry,
+        )
+        state = self._run_stage(1, state, x, local_var_x, universe)
+        best_mask, best_pattern = self._finalize_best(state)
+
+        return AttackResult(
+            adv_mask=best_mask,
+            adv_pattern=best_pattern,
+            y=np.asarray(state.y),
+            targeted=np.asarray(state.targeted),
+            stage0_mask=stage0_mask,
+            stage0_pattern=stage0_pattern,
+        )

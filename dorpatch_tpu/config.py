@@ -39,6 +39,7 @@ class AttackConfig:
     density: float = 1e-3          # density regularization coefficient
     structured: float = 1e-3       # structured (TV) loss coefficient
     eps: float = 4.0               # L2 budget for the patch delta
+    mask_fill: float = 0.5         # occlusion gray fill (attack.py:206)
     dual: bool = False             # second independent occlusion layer per sample
     num_patch: int = -1            # bookkeeping only (results path), as in reference
 

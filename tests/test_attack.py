@@ -1,0 +1,168 @@
+"""DorPatch attack engine tests: patch selection, target switching, sampling
+shape-invariants, and a smoke end-to-end attack on a tiny victim."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu.attack import (
+    AttackResult,
+    DorPatch,
+    TrainState,
+    majority_incorrect_label,
+    patch_selection,
+)
+from dorpatch_tpu.config import AttackConfig
+
+
+# ---------- patch_selection ----------
+
+def test_patch_selection_topk_groups():
+    h = w = 16
+    unit = 4
+    mask = np.zeros((1, h, w, 1), np.float32)
+    # plant mass in three cells: (0,0) heavy, (2,1) medium, (3,3) light
+    mask[0, 0:4, 0:4, 0] = 1.0
+    mask[0, 8:12, 4:8, 0] = 0.5
+    mask[0, 12:16, 12:16, 0] = 0.1
+    # budget for exactly 2 cells: k = floor(16*16*b/16) = 2 -> b = 2*16/256
+    out = np.asarray(patch_selection(jnp.asarray(mask), 2 * 16 / 256, unit))
+    assert set(np.unique(out)) <= {0.0, 1.0}
+    assert out[0, 0:4, 0:4, 0].all()
+    assert out[0, 8:12, 4:8, 0].all()
+    assert out.sum() == 2 * unit * unit
+
+
+def test_patch_selection_skips_empty_groups():
+    """Cells with zero mass are not selected even when k allows more."""
+    mask = np.zeros((1, 16, 16, 1), np.float32)
+    mask[0, 0:4, 0:4, 0] = 1.0
+    out = np.asarray(patch_selection(jnp.asarray(mask), 3 * 16 / 256, 4))
+    assert out.sum() == 16  # only the one positive cell
+
+
+def test_patch_selection_batched():
+    mask = np.random.default_rng(0).uniform(size=(3, 16, 16, 1)).astype(np.float32)
+    out = np.asarray(patch_selection(jnp.asarray(mask), 0.25, 4))
+    assert out.shape == (3, 16, 16, 1)
+    k = int(np.floor(16 * 16 * 0.25 / 16))
+    for b in range(3):
+        assert out[b].sum() == k * 16  # all cells positive -> exactly k groups
+
+
+# ---------- target selection ----------
+
+def test_majority_incorrect_label():
+    y = jnp.asarray([3, 1])
+    preds = jnp.asarray([
+        [3, 3, 5, 5, 5, 2],   # misclassified: 5,5,5,2 -> mode 5
+        [1, 1, 1, 1, 1, 1],   # none misclassified -> keep label
+    ])
+    out, has = majority_incorrect_label(preds, y, 8)
+    assert np.asarray(out).tolist() == [5, 1]
+    assert np.asarray(has).tolist() == [True, False]
+
+
+def test_majority_incorrect_tie_takes_smallest():
+    y = jnp.asarray([0])
+    preds = jnp.asarray([[4, 4, 2, 2, 0, 0]])  # ties 4 vs 2 -> smallest (2)
+    assert int(majority_incorrect_label(preds, y, 8)[0][0]) == 2
+
+
+# ---------- sampling invariants ----------
+
+def _tiny_attack(cfg=None, num_classes=4):
+    def apply_fn(params, x):
+        # cheap "model": class scores from pooled pixel stats
+        s = x.mean(axis=(1, 2))  # [B,3]
+        logits = jnp.stack(
+            [s[:, 0], s[:, 1], s[:, 2], s.sum(-1) / 3.0], axis=-1)
+        return logits * 10
+    cfg = cfg or AttackConfig()
+    return DorPatch(apply_fn, None, num_classes, cfg, remat=False)
+
+
+def test_sample_indices_static_and_biased():
+    cfg = AttackConfig(sampling_size=8, failure_sampling_start=0)
+    atk = _tiny_attack(cfg)
+    failed = jnp.zeros(50, bool).at[jnp.asarray([4, 9, 11])].set(True)
+    idx, from_fail = atk._sample_indices(jax.random.PRNGKey(0), failed, jnp.asarray(5))
+    idx, from_fail = np.asarray(idx), np.asarray(from_fail)
+    assert idx.shape == (8,)
+    assert from_fail.sum() == 3  # min(n_failed=3, half=4)
+    assert set(idx[from_fail]) <= {4, 9, 11}
+    assert len(set(idx[from_fail])) == from_fail.sum()  # without replacement
+    # universe part without replacement within itself
+    uni = idx[~from_fail]
+    assert len(set(uni)) == len(uni)
+
+
+def test_sample_indices_before_start_ignores_failures():
+    cfg = AttackConfig(sampling_size=8, failure_sampling_start=1000)
+    atk = _tiny_attack(cfg)
+    failed = jnp.ones(50, bool)
+    _, from_fail = atk._sample_indices(jax.random.PRNGKey(1), failed, jnp.asarray(999))
+    assert np.asarray(from_fail).sum() == 0
+
+
+# ---------- end-to-end smoke attack ----------
+
+@pytest.mark.slow
+def test_generate_smoke():
+    cfg = AttackConfig(
+        sampling_size=8,
+        max_iterations=30,
+        sweep_interval=10,
+        switch_iteration=10,
+        failure_sampling_start=20,
+        dropout=1,
+        patch_budget=0.15,
+        basic_unit=4,
+        lr=0.05,
+    )
+    atk = _tiny_attack(cfg)
+    x = jax.random.uniform(jax.random.PRNGKey(2), (2, 32, 32, 3)) * 0.2
+    blocks = []
+    atk.on_block_end = lambda stage, i, info: blocks.append((stage, i, info["n_failed"]))
+
+    res = atk.generate(x, key=jax.random.PRNGKey(3))
+    assert isinstance(res, AttackResult)
+    assert res.adv_mask.shape == (2, 32, 32, 1)
+    assert res.adv_pattern.shape == (2, 32, 32, 3)
+    # stage-1 mask is the frozen hard selection
+    vals = np.unique(np.asarray(res.adv_mask))
+    assert set(vals) <= {0.0, 1.0}
+    assert np.asarray(res.adv_pattern).min() >= 0.0
+    assert np.asarray(res.adv_pattern).max() <= 1.0
+    assert np.asarray(res.targeted).any()  # switch happened (switch_iteration=10 < 30)
+    assert len(blocks) >= 2 and blocks[0][0] == 0 and blocks[-1][0] == 1
+    assert all(np.isfinite(b[2]) for b in blocks)
+
+
+@pytest.mark.slow
+def test_generate_stage0_store_roundtrip(tmp_path):
+    class Store:
+        def __init__(self):
+            self.saved = {}
+
+        def load_stage0(self, batch_id):
+            return self.saved.get(batch_id)
+
+        def save_stage0(self, batch_id, mask, pattern):
+            self.saved[batch_id] = (mask, pattern)
+
+    cfg = AttackConfig(
+        sampling_size=4, max_iterations=10, sweep_interval=5,
+        switch_iteration=5, dropout=1, basic_unit=4, patch_budget=0.15,
+    )
+    atk = _tiny_attack(cfg)
+    x = jax.random.uniform(jax.random.PRNGKey(4), (1, 32, 32, 3)) * 0.3
+    store = Store()
+    res1 = atk.generate(x, key=jax.random.PRNGKey(5), store=store, batch_id=0)
+    assert 0 in store.saved
+    # second run resumes stage 0 from the store
+    res2 = atk.generate(x, key=jax.random.PRNGKey(5), store=store, batch_id=0)
+    np.testing.assert_array_equal(
+        np.asarray(res1.stage0_mask), np.asarray(res2.stage0_mask))
