@@ -1,0 +1,120 @@
+"""Results-directory contract + artifact store (resume/caching layer).
+
+Replicates the reference's persistence interfaces exactly so torch- and
+jax-produced artifacts interchange:
+
+- the config-derived results path (`/root/reference/utils.py:24-44`):
+  `results/<k=v config string>/<num_patch=.._patch_budget=..>`, with the
+  uninformative keys dropped;
+- per-batch patch artifacts `adv_mask_%d.pt` / `adv_pattern_%d.pt`
+  (`/root/reference/main.py:101-106,135-138`), stored as torch NCHW tensors;
+- stage-0 artifacts in the *parent* directory, shared across patch budgets
+  (`/root/reference/attack.py:102-103,134-141,348-356`);
+- pickled PatchCleanser records `adv_PC_%d.pt` (`/root/reference/main.py:144-153`).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from dorpatch_tpu.config import ExperimentConfig
+
+
+def results_path(cfg: ExperimentConfig) -> str:
+    """Config -> nested results dir, byte-compatible with the reference's
+    `generate_saving_path` for the shared keys (key order and formatting
+    follow the reference's argparse-dict order)."""
+    keys = [
+        ("dataset", cfg.dataset),
+        ("base_arch", cfg.base_arch),
+        ("targeted", cfg.attack.targeted),
+        ("attack", cfg.attack_name),
+        ("dropout", cfg.attack.dropout),
+        ("density", cfg.attack.density),
+        ("structured", cfg.attack.structured),
+    ]
+    top = "_".join(f"{k}={v}" for k, v in keys)
+    sub = f"num_patch={cfg.attack.num_patch}_patch_budget={cfg.attack.patch_budget}"
+    return os.path.join(cfg.results_root, top, sub)
+
+
+def _to_torch_nchw(arr: np.ndarray):
+    import torch
+
+    # copy: the source may be a non-writable jax buffer
+    return torch.from_numpy(np.array(np.moveaxis(arr, -1, 1), copy=True))
+
+
+def _from_torch_nchw(t) -> np.ndarray:
+    return np.moveaxis(np.asarray(t.detach().cpu(), dtype=np.float32), 1, -1)
+
+
+class ArtifactStore:
+    """Filesystem store rooted at the config's results dir.
+
+    NHWC<->NCHW conversion happens at the boundary: files hold torch NCHW
+    tensors (the reference's on-disk format), the framework works in NHWC.
+    """
+
+    def __init__(self, result_dir: str):
+        self.result_dir = result_dir
+        self.parent_dir = os.path.dirname(result_dir)
+        os.makedirs(result_dir, exist_ok=True)
+
+    # -- per-batch final patches (`main.py:101-106,135-138`) --
+
+    def _patch_paths(self, batch_id: int, root: str) -> Tuple[str, str]:
+        return (
+            os.path.join(root, f"adv_mask_{batch_id}.pt"),
+            os.path.join(root, f"adv_pattern_{batch_id}.pt"),
+        )
+
+    def load_patch(self, batch_id: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        return self._load_pair(*self._patch_paths(batch_id, self.result_dir))
+
+    def save_patch(self, batch_id: int, mask: np.ndarray, pattern: np.ndarray):
+        self._save_pair(self._patch_paths(batch_id, self.result_dir), mask, pattern)
+
+    # -- stage-0 artifacts in the parent dir, shared across budgets --
+
+    def load_stage0(self, batch_id: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        return self._load_pair(*self._patch_paths(batch_id, self.parent_dir))
+
+    def save_stage0(self, batch_id: int, mask: np.ndarray, pattern: np.ndarray):
+        os.makedirs(self.parent_dir, exist_ok=True)
+        self._save_pair(self._patch_paths(batch_id, self.parent_dir), mask, pattern)
+
+    def _load_pair(self, mask_path, pattern_path):
+        import torch
+
+        if not (os.path.exists(mask_path) and os.path.exists(pattern_path)):
+            return None
+        mask = torch.load(mask_path, map_location="cpu", weights_only=True)
+        pattern = torch.load(pattern_path, map_location="cpu", weights_only=True)
+        return _from_torch_nchw(mask), _from_torch_nchw(pattern)
+
+    def _save_pair(self, paths, mask, pattern):
+        import torch
+
+        torch.save(_to_torch_nchw(np.asarray(mask)), paths[0])
+        torch.save(_to_torch_nchw(np.asarray(pattern)), paths[1])
+
+    # -- PatchCleanser record cache (`main.py:144-153`) --
+
+    def _pc_path(self, batch_id: int) -> str:
+        return os.path.join(self.result_dir, f"adv_PC_{batch_id}.pt")
+
+    def load_pc_records(self, batch_id: int):
+        path = self._pc_path(batch_id)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def save_pc_records(self, batch_id: int, records: Sequence):
+        with open(self._pc_path(batch_id), "wb") as f:
+            pickle.dump(records, f)

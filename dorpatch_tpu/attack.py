@@ -96,6 +96,9 @@ def patch_selection(
     sel = jax.vmap(lambda s, i, u: s.at[i].set(u))(sel, idxs, updates)
     sel = sel.reshape(b, hp, wp)
     sel = jnp.repeat(jnp.repeat(sel, basic_unit, axis=1), basic_unit, axis=2)
+    # zero-pad edge pixels not covered by a full cell (when basic_unit does
+    # not divide H/W; at the reference's 224/7 geometry this is empty)
+    sel = jnp.pad(sel, ((0, 0), (0, h - sel.shape[1]), (0, w - sel.shape[2])))
     return sel[..., None]
 
 

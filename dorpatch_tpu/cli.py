@@ -1,0 +1,98 @@
+"""CLI (the reference's argparse surface, `/root/reference/main.py:8-41`,
+plus backend/mesh/synthetic extensions).
+
+Run:  python -m dorpatch_tpu.cli --dataset cifar10 --synthetic ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+from dorpatch_tpu.config import AttackConfig, DefenseConfig, ExperimentConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU-native DorPatch: distributed occlusion-robust "
+        "adversarial patches vs certified defenses")
+    # reference flags (`main.py:8-41`)
+    p.add_argument("--device", default="0", help="accelerator selector (kept for CLI parity)")
+    p.add_argument("--dataset", "-d", default="imagenet",
+                   choices=["cifar10", "imagenet", "cifar100"])
+    p.add_argument("--data_dir", default="/home/data/data")
+    p.add_argument("--model_dir", default="pretrained_models/")
+    p.add_argument("--base_arch", "-ba", default="resnetv2",
+                   choices=["resnetv2", "vit", "resmlp", "resnet18"])
+    p.add_argument("--targeted", "-t", action="store_true")
+    p.add_argument("--patch_budget", type=float, default=0.12)
+    p.add_argument("--attack", "-a", default="DorPatch", choices=["DorPatch"])
+    p.add_argument("-b", "--batch-size", type=int, default=1)
+    p.add_argument("-e", "--epsilon", type=float, default=4.0, help="L2 bound")
+    p.add_argument("--lr", "--learning-rate", type=float, default=0.01)
+    p.add_argument("--num_patch", type=int, default=-1)
+    p.add_argument("--dropout", type=int, default=2, choices=[0, 1, 2])
+    p.add_argument("--density", type=float, default=1e-3)
+    p.add_argument("--structured", type=float, default=1e-3)
+    # extensions
+    p.add_argument("--backend", default="jax-tpu", choices=["jax-tpu", "torch"])
+    p.add_argument("--synthetic", action="store_true",
+                   help="synthetic data (no dataset on disk needed)")
+    p.add_argument("--num-batches", type=int, default=10)
+    p.add_argument("--max-iterations", type=int, default=5000)
+    p.add_argument("--sampling-size", type=int, default=128)
+    p.add_argument("--basic-unit", type=int, default=7,
+                   help="patch group cell size (reference hardcodes 7)")
+    p.add_argument("--img-size", type=int, default=224)
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--results-root", default="results")
+    p.add_argument("--mesh-data", type=int, default=1)
+    p.add_argument("--mesh-mask", type=int, default=1)
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
+    attack = AttackConfig(
+        patch_budget=args.patch_budget,
+        targeted=args.targeted,
+        lr=args.lr,
+        max_iterations=args.max_iterations,
+        basic_unit=args.basic_unit,
+        dropout=args.dropout,
+        sampling_size=args.sampling_size,
+        density=args.density,
+        structured=args.structured,
+        eps=args.epsilon,
+        num_patch=args.num_patch,
+    )
+    return ExperimentConfig(
+        dataset=args.dataset,
+        data_dir=args.data_dir,
+        model_dir=args.model_dir,
+        base_arch=args.base_arch,
+        attack_name=args.attack,
+        batch_size=args.batch_size,
+        num_batches=args.num_batches,
+        seed=args.seed,
+        backend=args.backend,
+        device=args.device,
+        results_root=args.results_root,
+        synthetic_data=args.synthetic,
+        img_size=args.img_size,
+        mesh_data=args.mesh_data,
+        mesh_mask=args.mesh_mask,
+        attack=attack,
+        defense=DefenseConfig(),
+    )
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    from dorpatch_tpu.pipeline import run_experiment
+
+    return run_experiment(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""Data pipeline (the reference's loader layer, `/root/reference/utils.py:81-102`).
+
+Replicates Resize(img/0.875) -> CenterCrop(img) -> [0,1] float, shuffled with
+a seed, yielding NHWC numpy batches — without torchvision (absent in this
+environment). Sources:
+
+- `synthetic`: deterministic random images + labels, so every pipeline stage
+  runs without datasets on disk (tests, benchmarks);
+- `cifar10` / `cifar100`: the standard python-pickle batch files under
+  `<data_dir>/<name>/cifar-10-batches-py` / `cifar-100-python` (32px images
+  are resized like the reference does);
+- `imagenet`: `<data_dir>/imagenet/val/<wnid>/*.JPEG` folder layout via PIL.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Iterator, Tuple
+
+import numpy as np
+
+from dorpatch_tpu.config import NUM_CLASSES
+
+
+def _resize_center_crop(img: "np.ndarray", size: int) -> np.ndarray:
+    """PIL bilinear resize of the short side to size/0.875, center crop."""
+    from PIL import Image
+
+    resize_to = int(size / 0.875)
+    pil = Image.fromarray(img)
+    w, h = pil.size
+    scale = resize_to / min(w, h)
+    pil = pil.resize((max(1, round(w * scale)), max(1, round(h * scale))), Image.BILINEAR)
+    w, h = pil.size
+    left, top = (w - size) // 2, (h - size) // 2
+    pil = pil.crop((left, top, left + size, top + size))
+    return np.asarray(pil, dtype=np.float32) / 255.0
+
+
+def synthetic_batches(
+    dataset: str, batch_size: int, img_size: int, seed: int = 1234
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Deterministic synthetic stream: smooth random images (so TV losses see
+    structure) with random labels."""
+    rng = np.random.default_rng(seed)
+    n_classes = NUM_CLASSES[dataset]
+    while True:
+        low = rng.uniform(0, 1, (batch_size, 8, 8, 3)).astype(np.float32)
+        imgs = np.stack([
+            _resize_center_crop((lo * 255).astype(np.uint8), img_size) for lo in low
+        ])
+        labels = rng.integers(0, n_classes, batch_size)
+        yield imgs, labels.astype(np.int64)
+
+
+def _load_cifar(data_dir: str, name: str):
+    if name == "cifar10":
+        base = os.path.join(data_dir, name, "cifar-10-batches-py")
+        paths = [os.path.join(base, "test_batch")]
+        label_key = b"labels"
+    else:
+        base = os.path.join(data_dir, name, "cifar-100-python")
+        paths = [os.path.join(base, "test")]
+        label_key = b"fine_labels"
+    imgs, labels = [], []
+    for p in paths:
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        imgs.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        labels.extend(d[label_key])
+    return np.concatenate(imgs), np.asarray(labels, np.int64)
+
+
+def _imagenet_val_entries(data_dir: str):
+    root = os.path.join(data_dir, "imagenet", "val")
+    classes = sorted(d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+    entries = []
+    for ci, cname in enumerate(classes):
+        cdir = os.path.join(root, cname)
+        for fname in sorted(os.listdir(cdir)):
+            entries.append((os.path.join(cdir, fname), ci))
+    return entries
+
+
+def dataset_batches(
+    dataset: str,
+    data_dir: str,
+    batch_size: int,
+    img_size: int = 224,
+    seed: int = 1234,
+    synthetic: bool = False,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Shuffled eval-split batches, NHWC float32 in [0,1] (the reference's
+    `get_dataset` with shuffle=True and the eval transform)."""
+    if synthetic:
+        yield from synthetic_batches(dataset, batch_size, img_size, seed)
+        return
+
+    rng = np.random.default_rng(seed)
+    if dataset in ("cifar10", "cifar100"):
+        imgs, labels = _load_cifar(data_dir, dataset)
+        order = rng.permutation(len(imgs))
+        for i in range(0, len(order), batch_size):
+            sel = order[i:i + batch_size]
+            batch = np.stack([_resize_center_crop(imgs[j], img_size) for j in sel])
+            yield batch, labels[sel]
+    elif dataset == "imagenet":
+        from PIL import Image
+
+        entries = _imagenet_val_entries(data_dir)
+        order = rng.permutation(len(entries))
+        for i in range(0, len(order), batch_size):
+            sel = order[i:i + batch_size]
+            batch, labs = [], []
+            for j in sel:
+                path, lab = entries[j]
+                img = np.asarray(Image.open(path).convert("RGB"))
+                batch.append(_resize_center_crop(img, img_size))
+                labs.append(lab)
+            yield np.stack(batch), np.asarray(labs, np.int64)
+    else:
+        raise ValueError(f"unknown dataset {dataset!r}")

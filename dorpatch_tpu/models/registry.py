@@ -40,11 +40,14 @@ class Victim(NamedTuple):
 
 
 def resolve_arch(arch: str) -> str:
-    """Substring match against supported timm names (`utils.py:55-57`)."""
+    """Substring match against supported timm names (`utils.py:55-57`),
+    plus the framework's own small CIFAR victim for sweep configs."""
+    if arch in ("resnet18", "cifar_resnet18"):
+        return "cifar_resnet18"
     for tm in TIMM_MODELS:
         if arch in tm:
             return tm
-    raise ValueError(f"unknown architecture {arch!r}; supported: {TIMM_MODELS}")
+    raise ValueError(f"unknown architecture {arch!r}; supported: {TIMM_MODELS + ('cifar_resnet18',)}")
 
 
 def checkpoint_path(model_dir: str, dataset: str, timm_name: str) -> str:
@@ -65,6 +68,10 @@ def _build_flax(timm_name: str, num_classes: int):
         from dorpatch_tpu.models.resmlp import resmlp_24
 
         return resmlp_24(num_classes)
+    if timm_name == "cifar_resnet18":
+        from dorpatch_tpu.models.small import CifarResNet18
+
+        return CifarResNet18(num_classes=num_classes)
     raise NotImplementedError(timm_name)
 
 

@@ -1,0 +1,129 @@
+"""Pipeline-layer tests: results-path contract, artifact store roundtrips,
+metrics math, CLI parsing, and a tiny synthetic end-to-end experiment."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dorpatch_tpu import metrics
+from dorpatch_tpu.artifacts import ArtifactStore, results_path
+from dorpatch_tpu.cli import build_parser, config_from_args
+from dorpatch_tpu.config import AttackConfig, DefenseConfig, ExperimentConfig
+
+
+def test_results_path_matches_reference_contract():
+    cfg = ExperimentConfig(results_root="results")
+    p = results_path(cfg)
+    assert p == os.path.join(
+        "results",
+        "dataset=imagenet_base_arch=resnetv2_targeted=False_attack=DorPatch_"
+        "dropout=2_density=0.001_structured=0.001",
+        "num_patch=-1_patch_budget=0.12",
+    )
+
+
+def test_artifact_store_roundtrip(tmp_path):
+    store = ArtifactStore(str(tmp_path / "results" / "cfg" / "sub"))
+    mask = np.random.default_rng(0).uniform(size=(1, 16, 16, 1)).astype(np.float32)
+    pattern = np.random.default_rng(1).uniform(size=(1, 16, 16, 3)).astype(np.float32)
+
+    assert store.load_patch(0) is None
+    store.save_patch(0, mask, pattern)
+    m2, p2 = store.load_patch(0)
+    np.testing.assert_allclose(m2, mask)
+    np.testing.assert_allclose(p2, pattern)
+
+    # on-disk format is torch NCHW (reference interchange)
+    import torch
+
+    t = torch.load(str(tmp_path / "results" / "cfg" / "sub" / "adv_mask_0.pt"),
+                   weights_only=True)
+    assert tuple(t.shape) == (1, 1, 16, 16)
+
+    # stage-0 artifacts live in the parent dir, shared across budgets
+    assert store.load_stage0(3) is None
+    store.save_stage0(3, mask, pattern)
+    assert os.path.exists(str(tmp_path / "results" / "cfg" / "adv_mask_3.pt"))
+    other = ArtifactStore(str(tmp_path / "results" / "cfg" / "other_budget"))
+    assert other.load_stage0(3) is not None
+
+    store.save_pc_records(0, [["rec"]])
+    assert store.load_pc_records(0) == [["rec"]]
+
+
+def test_metrics_math():
+    class R:
+        def __init__(self, p, c):
+            self.predictions = np.asarray(p)
+            self.certifications = np.asarray(c)
+
+    y = np.asarray([0, 0, 1, 1])
+    preds_clean = np.asarray([0, 0, 1, 0])
+    preds_adv = np.asarray([1, 0, 0, 0])
+    res = R([1, 0, 1, 0], [True, True, False, True])
+    m = metrics.compute_metrics(preds_clean, y, preds_adv, [res])
+    assert m["clean_accuracy"] == 75.0
+    assert m["robust_accuracy"] == 25.0
+    assert m["acc_pc"] == [50.0]                    # preds==y: idx1, idx2
+    assert m["certified_acc_pc"] == [25.0]          # certified & correct: idx1
+    assert m["certified_asr_pc"] == [50.0]          # certified & wrong: idx0, idx3
+    mt = metrics.compute_metrics(
+        preds_clean, y, preds_adv, [res], targets=np.asarray([1, 1, 0, 0]))
+    assert mt["certified_asr_pc"] == [50.0]         # certified & ==target: idx0, idx3
+    assert "clean accuracy: 75.00%" in metrics.report_line(m)
+
+
+def test_cli_reference_flags():
+    args = build_parser().parse_args(
+        ["-d", "cifar10", "-ba", "resnetv2", "-t", "--patch_budget", "0.06",
+         "-b", "4", "-e", "2.5", "--lr", "0.02", "--dropout", "1",
+         "--synthetic", "--max-iterations", "7"])
+    cfg = config_from_args(args)
+    assert cfg.dataset == "cifar10"
+    assert cfg.attack.targeted
+    assert cfg.attack.patch_budget == 0.06
+    assert cfg.batch_size == 4
+    assert cfg.attack.eps == 2.5
+    assert cfg.attack.lr == 0.02
+    assert cfg.attack.dropout == 1
+    assert cfg.synthetic_data
+    assert cfg.attack.max_iterations == 7
+
+
+@pytest.mark.slow
+def test_synthetic_e2e(tmp_path):
+    """Tiny full experiment: synthetic cifar10, small victim, 2 batches."""
+    from dorpatch_tpu.pipeline import run_experiment
+
+    cfg = ExperimentConfig(
+        dataset="cifar10",
+        base_arch="resnet18",
+        batch_size=2,
+        num_batches=2,
+        synthetic_data=True,
+        img_size=32,
+        results_root=str(tmp_path / "results"),
+        attack=AttackConfig(
+            sampling_size=6, max_iterations=10, sweep_interval=5,
+            switch_iteration=5, dropout=1, basic_unit=4, patch_budget=0.15,
+        ),
+        defense=DefenseConfig(ratios=(0.06,), chunk_size=18),
+    )
+    m = run_experiment(cfg, verbose=False)
+    assert set(m) >= {"clean_accuracy", "robust_accuracy", "acc_pc",
+                      "certified_acc_pc", "certified_asr_pc", "report"}
+    assert len(m["acc_pc"]) == 1
+    # resume path: second run must reuse artifacts (no attack rerun) and give
+    # identical metrics
+    m2 = run_experiment(cfg, verbose=False)
+    assert m2["report"] == m["report"]
+
+
+def test_unknown_backend_rejected():
+    from dorpatch_tpu.pipeline import run_experiment
+
+    with pytest.raises(ValueError):
+        run_experiment(ExperimentConfig(backend="mlx"))
+    with pytest.raises(NotImplementedError):
+        run_experiment(ExperimentConfig(backend="torch"))
