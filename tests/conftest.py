@@ -16,3 +16,11 @@ _flags = [
 ]
 _flags.append("--xla_force_host_platform_device_count=8")
 os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+# A sitecustomize-registered accelerator plugin may programmatically set
+# jax_platforms before this conftest runs, which beats the env var. Re-force
+# CPU through the config API — this wins as long as no backend has been
+# initialized yet (no jax.devices()/jit call has happened).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
