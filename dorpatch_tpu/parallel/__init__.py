@@ -1,0 +1,33 @@
+"""Mesh parallelism: the TPU-native replacement for the reference's
+`torch.nn.DataParallel` layer (`/root/reference/main.py:53`). See mesh.py for
+the (data, mask) axis design and sharded.py for the attack/defense factories."""
+
+from dorpatch_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MASK_AXIS,
+    data_sharding,
+    flat_batch_sharding,
+    make_mesh,
+    place_batch,
+    place_replicated,
+    replicated,
+    shard_apply_fn,
+)
+from dorpatch_tpu.parallel.sharded import (
+    make_sharded_attack,
+    make_sharded_defenses,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MASK_AXIS",
+    "data_sharding",
+    "flat_batch_sharding",
+    "make_mesh",
+    "make_sharded_attack",
+    "make_sharded_defenses",
+    "place_batch",
+    "place_replicated",
+    "replicated",
+    "shard_apply_fn",
+]
