@@ -58,7 +58,14 @@ def make_mesh(
         raise ValueError(f"mesh {data}x{mask} needs {n} devices, have {len(devices)}")
     if n == len(devices):
         n_slices = len({getattr(d, "slice_index", 0) for d in devices})
-        if n_slices > 1 and data % n_slices == 0:
+        if n_slices > 1:
+            if data % n_slices:
+                raise ValueError(
+                    f"{n_slices} DCN-connected slices: the data axis must be a"
+                    f" multiple of the slice count (got data={data}) so the"
+                    f" mask axis stays on ICI; use"
+                    f" make_mesh(data={n_slices}*images_per_slice_groups, ...)"
+                )
             # Multi-slice: pin the data axis across DCN granules and keep the
             # mask axis inside each slice's ICI torus, so the per-step
             # mask-axis loss/grad all-reduce never crosses DCN.
@@ -76,20 +83,24 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _axis_sharding(mesh: Mesh, ndim: int, axis_spec, axis: int = 0) -> NamedSharding:
+    if ndim < 1 or not (0 <= axis < ndim):
+        raise ValueError(f"cannot shard axis {axis} of a rank-{ndim} array")
+    spec = [None] * ndim
+    spec[axis] = axis_spec
+    return NamedSharding(mesh, P(*spec))
+
+
 def data_sharding(mesh: Mesh, ndim: int, axis: int = 0) -> NamedSharding:
     """Shard dimension `axis` of an ndim-array over the data axis."""
-    spec = [None] * ndim
-    spec[axis] = DATA_AXIS
-    return NamedSharding(mesh, P(*spec))
+    return _axis_sharding(mesh, ndim, DATA_AXIS, axis)
 
 
 def flat_batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
     """Sharding for a flattened ``[B*S, ...]`` model batch: the leading axis
     split over *both* mesh axes — every chip gets an equal slice of the
     masked-image batch, exactly DataParallel's scatter but compiled."""
-    spec = [None] * ndim
-    spec[0] = (DATA_AXIS, MASK_AXIS)
-    return NamedSharding(mesh, P(*spec))
+    return _axis_sharding(mesh, ndim, (DATA_AXIS, MASK_AXIS))
 
 
 def shard_apply_fn(
@@ -135,6 +146,10 @@ def place_batch(mesh: Mesh, x: jax.Array, *per_image):
         raise ValueError(
             f"batch {x.shape[0]} not divisible by data axis size {n_data}")
     out = [jax.device_put(x, data_sharding(mesh, np.ndim(x)))]
-    for a in per_image:
+    for pos, a in enumerate(per_image):
+        if np.ndim(a) < 1 or np.shape(a)[0] != x.shape[0]:
+            raise ValueError(
+                f"per_image arg {pos} must have leading dim {x.shape[0]}, "
+                f"got shape {np.shape(a)}")
         out.append(jax.device_put(a, data_sharding(mesh, np.ndim(a))))
     return out[0] if not per_image else tuple(out)

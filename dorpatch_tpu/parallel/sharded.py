@@ -25,12 +25,7 @@ import jax
 from dorpatch_tpu.attack import DorPatch
 from dorpatch_tpu.config import AttackConfig, DefenseConfig
 from dorpatch_tpu.defense import PatchCleanser, build_defenses
-from dorpatch_tpu.parallel.mesh import (
-    Mesh,
-    place_batch,
-    place_replicated,
-    shard_apply_fn,
-)
+from dorpatch_tpu.parallel.mesh import Mesh, place_replicated, shard_apply_fn
 
 
 def make_sharded_attack(
@@ -72,6 +67,4 @@ def make_sharded_defenses(
 __all__ = [
     "make_sharded_attack",
     "make_sharded_defenses",
-    "place_batch",
-    "place_replicated",
 ]

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dorpatch_tpu import losses, metrics
+from dorpatch_tpu import losses, metrics, parallel
 from dorpatch_tpu.artifacts import ArtifactStore, results_path
 from dorpatch_tpu.attack import DorPatch
 from dorpatch_tpu.config import ExperimentConfig
@@ -50,8 +50,16 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
     rng = np.random.default_rng(cfg.seed)
     victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size)
     store = ArtifactStore(results_path(cfg))
-    defenses = build_defenses(victim.apply, cfg.img_size, cfg.defense)
-    attack = DorPatch(victim.apply, victim.params, victim.num_classes, cfg.attack)
+    mesh = None
+    if cfg.mesh_data * cfg.mesh_mask > 1:
+        mesh = parallel.make_mesh(cfg.mesh_data, cfg.mesh_mask)
+        defenses = parallel.make_sharded_defenses(
+            victim.apply, cfg.img_size, mesh, cfg.defense)
+        attack = parallel.make_sharded_attack(
+            victim.apply, victim.params, victim.num_classes, cfg.attack, mesh)
+    else:
+        defenses = build_defenses(victim.apply, cfg.img_size, cfg.defense)
+        attack = DorPatch(victim.apply, victim.params, victim.num_classes, cfg.attack)
 
     preds_list: List[np.ndarray] = []
     y_list: List[np.ndarray] = []
@@ -81,6 +89,14 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
         x = x[jnp.asarray(correct)]
         y_np = y_np[correct]
         preds = preds[correct]
+        if mesh is not None:
+            # the correctness filter makes the surviving batch size dynamic;
+            # shard it over the data axis when it divides, else replicate
+            # (per-image state is tiny next to the EOT activation batch)
+            try:
+                x = parallel.place_batch(mesh, x)
+            except ValueError:
+                x = jax.device_put(x, parallel.replicated(mesh))
 
         cached = store.load_patch(i)
         if cached is not None:
@@ -158,6 +174,7 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
         d.collect([r[di] for r in records])
     m = metrics.compute_metrics(
         preds_clean, y_all, preds_adv, [d.result for d in defenses], targets)
+    m["evaluated_images"] = int(len(y_all))
     m["report"] = metrics.report_line(m)
     if verbose:
         print(m["report"])

@@ -110,3 +110,30 @@ def test_sharded_defense_matches_unsharded():
         assert a.certification == b.certification
         np.testing.assert_array_equal(a.preds_1, b.preds_1)
         np.testing.assert_array_equal(a.preds_2, b.preds_2)
+
+
+@pytest.mark.slow
+def test_pipeline_uses_mesh(tmp_path):
+    """run_experiment with mesh knobs runs the sharded path end-to-end."""
+    from dorpatch_tpu.config import ExperimentConfig
+    from dorpatch_tpu.pipeline import run_experiment
+
+    cfg = ExperimentConfig(
+        dataset="cifar10",
+        base_arch="resnet18",
+        batch_size=2,
+        num_batches=1,
+        synthetic_data=True,
+        img_size=32,
+        results_root=str(tmp_path / "results"),
+        mesh_data=1,
+        mesh_mask=8,
+        attack=AttackConfig(
+            sampling_size=8, max_iterations=4, sweep_interval=2,
+            switch_iteration=2, dropout=1, basic_unit=4, patch_budget=0.15,
+        ),
+        defense=DefenseConfig(ratios=(0.06,), chunk_size=8),
+    )
+    m = run_experiment(cfg, verbose=False)
+    assert m["evaluated_images"] > 0
+    assert len(m["acc_pc"]) == 1
