@@ -218,6 +218,56 @@ class ResMLPTorch(nn.Module):
         return self.head(self.norm(x).mean(dim=1))
 
 
+class CifarBasicBlockTorch(nn.Module):
+    """Torch twin of `dorpatch_tpu.models.small.BasicBlock` (GroupNorm
+    ResNet-18 block), for CPU-fallback benchmarking and small-model parity."""
+
+    def __init__(self, in_ch, out_ch, stride=1):
+        super().__init__()
+        # eps=1e-6 matches the flax GroupNorm default used by the jax twin
+        self.conv1 = nn.Conv2d(in_ch, out_ch, 3, stride, 1, bias=False)
+        self.norm1 = nn.GroupNorm(8, out_ch, eps=1e-6)
+        self.conv2 = nn.Conv2d(out_ch, out_ch, 3, 1, 1, bias=False)
+        self.norm2 = nn.GroupNorm(8, out_ch, eps=1e-6)
+        self.proj = None
+        if in_ch != out_ch or stride != 1:
+            self.proj = nn.Sequential(
+                nn.Conv2d(in_ch, out_ch, 1, stride, bias=False),
+                nn.GroupNorm(8, out_ch, eps=1e-6),
+            )
+
+    def forward(self, x):
+        y = F.relu(self.norm1(self.conv1(x)))
+        y = self.norm2(self.conv2(y))
+        if self.proj is not None:
+            x = self.proj(x)
+        return F.relu(x + y)
+
+
+class CifarResNet18Torch(nn.Module):
+    """Torch twin of `dorpatch_tpu.models.small.CifarResNet18`."""
+
+    def __init__(self, num_classes=10, stage_sizes=(2, 2, 2, 2)):
+        super().__init__()
+        self.stem = nn.Conv2d(3, 64, 3, 1, 1, bias=False)
+        self.stem_norm = nn.GroupNorm(8, 64, eps=1e-6)
+        blocks = []
+        in_ch, features = 64, 64
+        for si, depth in enumerate(stage_sizes):
+            for bi in range(depth):
+                stride = 2 if (bi == 0 and si > 0) else 1
+                blocks.append(CifarBasicBlockTorch(in_ch, features, stride))
+                in_ch = features
+            features *= 2
+        self.blocks = nn.Sequential(*blocks)
+        self.head = nn.Linear(in_ch, num_classes)
+
+    def forward(self, x):
+        x = F.relu(self.stem_norm(self.stem(x)))
+        x = self.blocks(x)
+        return self.head(x.mean(dim=(2, 3)))
+
+
 class Normalized(nn.Module):
     """[0,1]-input wrapper: normalize with mean/std 0.5 then run the net
     (reference `NormModel` + `get_normalize`, `/root/reference/utils.py:66-78`)."""
@@ -239,4 +289,6 @@ def create_torch_model(arch: str, num_classes: int) -> nn.Module:
         return ViTTorch(num_classes=num_classes)
     if arch in ("resmlp", "resmlp_24_distilled_224"):
         return ResMLPTorch(num_classes=num_classes)
+    if arch in ("resnet18", "cifar_resnet18"):
+        return CifarResNet18Torch(num_classes=num_classes)
     raise NotImplementedError(f"torch backend arch: {arch}")

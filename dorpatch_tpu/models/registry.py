@@ -117,7 +117,9 @@ def get_model(
         from_checkpoint = True
     else:
         dummy = jnp.zeros((1, img_size, img_size, 3), jnp.float32)
-        params = model.init(jax.random.PRNGKey(seed), dummy)
+        # jit the initializer: eager init dispatches hundreds of tiny ops,
+        # which is pathologically slow over remote-tunneled TPU backends
+        params = jax.jit(model.init)(jax.random.PRNGKey(seed), dummy)
         from_checkpoint = False
 
     def apply(params, images01):
