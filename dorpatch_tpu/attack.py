@@ -39,6 +39,7 @@ import numpy as np
 
 from dorpatch_tpu import losses
 from dorpatch_tpu import masks as masks_lib
+from dorpatch_tpu import ops
 from dorpatch_tpu.config import AttackConfig
 from dorpatch_tpu.defense import masked_predictions
 
@@ -179,13 +180,15 @@ class DorPatch:
 
     # ---------- one optimization step ----------
 
-    def _loss_and_aux(self, adv_mask, adv_pattern, x, local_var_x, mask_imgs, state, stage):
+    def _loss_and_aux(self, adv_mask, adv_pattern, x, local_var_x, rects, state, stage):
         cfg = self.config
         b = x.shape[0]
-        s = mask_imgs.shape[0]  # effective EOT batch (clamped to universe size)
+        s = rects.shape[0]  # effective EOT batch (clamped to universe size)
         delta = losses.l2_project(adv_mask, adv_pattern, x, cfg.eps)
         adv_x = x + delta
-        masked = masks_lib.apply_masks(adv_x, mask_imgs, cfg.mask_fill)
+        # fused rasterize+fill (Pallas on TPU): the [S,H,W] mask tensor is
+        # never materialized; gradients flow to adv_x through the kept pixels
+        masked = ops.masked_fill(adv_x, rects, cfg.mask_fill, cfg.use_pallas)
         logits = self._fwd(self.params, masked.reshape((-1,) + x.shape[1:]))
         y_rep = jnp.repeat(state.y, s)
         targeted_rep = jnp.repeat(state.targeted, s)
@@ -218,16 +221,16 @@ class DorPatch:
         rng, k_samp, k_dual = jax.random.split(state.rng, 3)
 
         idx, from_fail = self._sample_indices(k_samp, state.failed, state.step)
-        mask_imgs = masks_lib.rasterize(universe[idx], x.shape[1]).astype(x.dtype)
+        rects = universe[idx]
         if cfg.dual:
+            # second independent occlusion layer (`attack.py:208-218`): the
+            # union of both rectangle sets, as extra rows on the K axis
             idx2, _ = self._sample_indices(k_dual, state.failed, state.step)
-            mask_imgs = mask_imgs * masks_lib.rasterize(
-                universe[idx2], x.shape[1]
-            ).astype(x.dtype)
+            rects = jnp.concatenate([rects, universe[idx2]], axis=1)
 
         grad_fn = jax.grad(self._loss_and_aux, argnums=(0, 1), has_aux=True)
         (g_mask, g_pattern), aux = grad_fn(
-            state.adv_mask, state.adv_pattern, x, local_var_x, mask_imgs, state, stage
+            state.adv_mask, state.adv_pattern, x, local_var_x, rects, state, stage
         )
 
         # ---- bookkeeping (`attack.py:249-342`), all as selects ----
@@ -344,7 +347,7 @@ class DorPatch:
                 preds = masked_predictions(
                     self._fwd, self.params, adv_x, universe,
                     min(self._sampling_size, universe.shape[0]),
-                    self.config.mask_fill,
+                    self.config.mask_fill, self.config.use_pallas,
                 )  # [B, n_mask]
                 hit = preds == y[:, None]
                 fail_per_img = jnp.where(targeted[:, None], ~hit, hit)

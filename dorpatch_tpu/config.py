@@ -59,6 +59,7 @@ class AttackConfig:
     loss_decay_margin: float = 1e-3            # improvement margin (attack.py:275)
     report_interval: int = 20                  # metrics cadence (attack.py:318)
     adapt_start: int = 200                     # stage-0 coeff adaptation start (attack.py:294)
+    use_pallas: str = "auto"                   # fused mask-fill kernel: auto|on|off|interpret
 
     @property
     def scale_down(self) -> float:
@@ -74,6 +75,7 @@ class DefenseConfig:
     num_mask_per_axis: int = NUM_MASKS_PER_AXIS
     mask_fill: float = 0.5          # gray fill (PatchCleanser.py:100)
     chunk_size: int = 64            # certification sweep chunking (PatchCleanser.py:102)
+    use_pallas: str = "auto"        # fused mask-fill kernel: auto|on|off|interpret
 
 
 @dataclasses.dataclass(frozen=True)

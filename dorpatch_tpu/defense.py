@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dorpatch_tpu import masks as masks_lib
+from dorpatch_tpu import ops
 from dorpatch_tpu.config import DefenseConfig
 
 
@@ -65,13 +66,15 @@ def masked_predictions(
     rects: jax.Array,
     chunk_size: int,
     fill: float = 0.5,
+    use_pallas: str = "auto",
 ) -> jax.Array:
     """Predictions under every mask in `rects`: `[B,H,W,C] x [N,K,4] -> [B,N]`.
 
     A `lax.scan` over chunks of the mask axis bounds live memory at
     `B * chunk_size` images while keeping each forward a large MXU-friendly
     batch (the reference's chunked sweeps, `PatchCleanser.py:102-112`,
-    `attack.py:384-406`, but compiled as one program).
+    `attack.py:384-406`, but compiled as one program). The mask-apply is the
+    fused `ops.masked_fill` (Pallas on TPU).
     """
     n = rects.shape[0]
     n_chunks = -(-n // chunk_size)
@@ -80,12 +83,10 @@ def masked_predictions(
         [jnp.asarray(rects, jnp.int32),
          jnp.zeros((pad,) + rects.shape[1:], jnp.int32)], axis=0
     ).reshape(n_chunks, chunk_size, *rects.shape[1:])
-    img_size = imgs.shape[1]
     batch = imgs.shape[0]
 
     def body(carry, chunk_rects):
-        m = masks_lib.rasterize(chunk_rects, img_size)
-        xm = masks_lib.apply_masks(imgs, m, fill)
+        xm = ops.masked_fill(imgs, chunk_rects, fill, use_pallas)
         logits = apply_fn(params, xm.reshape((-1,) + imgs.shape[1:]))
         return carry, jnp.argmax(logits, axis=-1).reshape(batch, chunk_size)
 
@@ -177,6 +178,7 @@ class PatchCleanser:
             preds = masked_predictions(
                 self.apply_fn, params, imgs, self._rects,
                 self.config.chunk_size, self.config.mask_fill,
+                self.config.use_pallas,
             )
             p1 = preds[:, : self._num_singles]
             p2 = preds[:, self._num_singles:]

@@ -1,0 +1,5 @@
+"""Hand-fused TPU ops (Pallas) for the framework's hot inner-loop primitives."""
+
+from dorpatch_tpu.ops.masked_fill import masked_fill, masked_fill_reference
+
+__all__ = ["masked_fill", "masked_fill_reference"]

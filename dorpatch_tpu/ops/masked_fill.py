@@ -1,0 +1,182 @@
+"""Fused rasterize + occlusion-fill: the framework's hottest memory-bound op.
+
+Every hot path funnels images through "occlude with mask m, gray-fill the
+rest": the attack's EOT forward applies 128 sampled occlusion masks per step
+(`/root/reference/attack.py:206`), the failure sweep applies all 2520
+(`attack.py:384-406`), and PatchCleanser applies its 36+630 certification
+masks (`defenses/PatchCleanser.py:99-100`). The reference materializes the
+boolean mask tensors once on the GPU and broadcasts; our jnp path
+(`masks.rasterize` + `masks.apply_masks`) rasterizes coordinate rectangles
+on device and lets XLA fuse.
+
+This module goes one step further on TPU: a Pallas kernel that rasterizes
+each rectangle set *inside* the kernel from its (r0, r1, c0, c1) corners
+(scalar-prefetched to SMEM) and writes the occluded image directly — the
+[S,H,W] mask tensor never exists in HBM, and the op reads `imgs` once and
+writes the [B,S,H,W,C] output once, the bandwidth lower bound. Images are
+viewed as [B, H, W*C] so the lane axis is W*C (672 @224, a 5.25×128-lane
+tile — fine for the VPU); the rasterizer compares row/lane iotas against
+the corners, with lane→column mapping `w = lane // C`.
+
+A `jax.custom_vjp` makes the op differentiable w.r.t. `imgs` (the attack
+backprops through the fill to the patch): the backward kernel accumulates
+`g * keep_mask` over the mask axis per image, again without materializing
+masks. Rectangle coordinates and the fill value are non-differentiable.
+
+`masked_fill` dispatches: Pallas on TPU backends, the jnp reference path
+elsewhere (CPU tests, virtual meshes) — numerically identical (pure
+select, no arithmetic on the kept pixels).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dorpatch_tpu import masks as masks_lib
+
+
+def masked_fill_reference(imgs: jax.Array, rects: jax.Array, fill: float) -> jax.Array:
+    """jnp reference: `[B,H,W,C] x [S,K,4] -> [B,S,H,W,C]`."""
+    m = masks_lib.rasterize(rects, imgs.shape[1])
+    return masks_lib.apply_masks(imgs, m, fill)
+
+
+# ---------------------------------------------------------------- kernels
+
+
+def _fwd_kernel(n_rect: int, channels: int, fill: float, rects_ref, img_ref, out_ref):
+    h, wc = img_ref.shape[1], img_ref.shape[2]
+    s = pl.program_id(1)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (1, h, wc), 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (1, h, wc), 2) // channels
+    occluded = jnp.zeros((1, h, wc), jnp.bool_)
+    for k in range(n_rect):  # K is tiny (1-3): unrolled
+        r0, r1 = rects_ref[s, k, 0], rects_ref[s, k, 1]
+        c0, c1 = rects_ref[s, k, 2], rects_ref[s, k, 3]
+        occluded |= (rows >= r0) & (rows < r1) & (cols >= c0) & (cols < c1)
+    out_ref[0] = jnp.where(occluded, jnp.asarray(fill, img_ref.dtype), img_ref[...])
+
+
+def _bwd_kernel(n_rect: int, channels: int, rects_ref, g_ref, out_ref):
+    h, wc = g_ref.shape[2], g_ref.shape[3]
+    s = pl.program_id(1)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (1, h, wc), 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (1, h, wc), 2) // channels
+    occluded = jnp.zeros((1, h, wc), jnp.bool_)
+    for k in range(n_rect):
+        r0, r1 = rects_ref[s, k, 0], rects_ref[s, k, 1]
+        c0, c1 = rects_ref[s, k, 2], rects_ref[s, k, 3]
+        occluded |= (rows >= r0) & (rows < r1) & (cols >= c0) & (cols < c1)
+    contrib = jnp.where(occluded, jnp.zeros((), g_ref.dtype), g_ref[0])
+
+    @pl.when(s == 0)
+    def _():
+        out_ref[...] = contrib
+
+    @pl.when(s != 0)
+    def _():
+        out_ref[...] = out_ref[...] + contrib
+
+
+def _pallas_fwd(imgs: jax.Array, rects: jax.Array, fill: float, interpret: bool) -> jax.Array:
+    b, h, w, c = imgs.shape
+    n_mask, n_rect = rects.shape[0], rects.shape[1]
+    flat = imgs.reshape(b, h, w * c)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, n_rect, c, fill),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, n_mask),
+            in_specs=[
+                pl.BlockSpec((1, h, w * c), lambda i, s, rects: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, h, w * c), lambda i, s, rects: (i, s, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n_mask, h, w * c), imgs.dtype),
+        interpret=interpret,
+    )(jnp.asarray(rects, jnp.int32), flat)
+    return out.reshape(b, n_mask, h, w, c)
+
+
+def _pallas_bwd(rects: jax.Array, g: jax.Array, interpret: bool) -> jax.Array:
+    b, n_mask, h, w, c = g.shape
+    n_rect = rects.shape[1]
+    flat = g.reshape(b, n_mask, h, w * c)
+    out = pl.pallas_call(
+        functools.partial(_bwd_kernel, n_rect, c),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            # mask axis iterates minor-to-major last → sequential per image,
+            # making the out-block accumulation across s well-defined
+            grid=(b, n_mask),
+            in_specs=[
+                pl.BlockSpec((1, 1, h, w * c), lambda i, s, rects: (i, s, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, h, w * c), lambda i, s, rects: (i, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, w * c), g.dtype),
+        interpret=interpret,
+    )(jnp.asarray(rects, jnp.int32), flat)
+    return out.reshape(b, h, w, c)
+
+
+# ------------------------------------------------------------- custom vjp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _masked_fill_pallas(imgs, rects, fill: float, interpret: bool):
+    return _pallas_fwd(imgs, rects, fill, interpret)
+
+
+def _vjp_fwd(imgs, rects, fill: float, interpret: bool):
+    return _pallas_fwd(imgs, rects, fill, interpret), rects
+
+
+def _vjp_bwd(fill: float, interpret: bool, rects, g):
+    return _pallas_bwd(rects, g, interpret), None
+
+
+_masked_fill_pallas.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def _auto_use_pallas() -> bool:
+    """Pallas iff single-device TPU. On a multi-chip mesh a `pallas_call`
+    is a Mosaic custom call that GSPMD cannot partition — it would stop the
+    mask-axis sharding propagation at the kernel boundary and replicate the
+    step's largest tensor per chip. The sharded path keeps the pure-XLA
+    rasterize+apply (which GSPMD splits along with the forward) until the
+    kernel grows a shard_map wrapper."""
+    try:
+        return jax.default_backend() in ("tpu", "axon") and jax.device_count() == 1
+    except Exception:
+        return False
+
+
+def masked_fill(
+    imgs: jax.Array,
+    rects: jax.Array,
+    fill: float = 0.5,
+    use_pallas: str = "auto",
+) -> jax.Array:
+    """Occlude `imgs` with every rectangle set in `rects`, filling with `fill`.
+
+    imgs `[B,H,W,C]`, rects `[S,K,4]` int32 rows `(r0, r1, c0, c1)`
+    (half-open; zero-area rows are no-ops, matching `masks.pad_rects`).
+    Returns `[B,S,H,W,C]`. Differentiable w.r.t. `imgs`.
+
+    use_pallas: "auto" (Pallas iff single-device TPU — see `_auto_use_pallas`
+    for why multi-chip meshes stay pure-XLA), "on", "off",
+    "interpret" (Pallas in interpreter mode — for CPU tests).
+    """
+    if use_pallas == "auto":
+        use_pallas = "on" if _auto_use_pallas() else "off"
+    if use_pallas == "off":
+        return masked_fill_reference(imgs, rects, fill)
+    if use_pallas not in ("on", "interpret"):
+        raise ValueError(f"use_pallas={use_pallas!r}")
+    return _masked_fill_pallas(imgs, rects, float(fill), use_pallas == "interpret")
