@@ -48,10 +48,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--results-root", default="results")
     p.add_argument("--mesh-data", type=int, default=1)
     p.add_argument("--mesh-mask", type=int, default=1)
+    p.add_argument("--trace-dir", default="",
+                   help="write a jax.profiler trace of the run here")
+    p.add_argument("--no-metrics-log", action="store_true",
+                   help="disable the structured metrics JSONL in the results dir")
+    p.add_argument("--use-pallas", default="auto",
+                   choices=["auto", "on", "off", "interpret"],
+                   help="fused mask-fill kernel dispatch")
     return p
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
+    if args.use_pallas == "on" and args.mesh_data * args.mesh_mask > 1:
+        raise SystemExit(
+            "--use-pallas on is single-device only: the Mosaic kernel is "
+            "opaque to GSPMD and would replicate the EOT tensor per chip. "
+            "Use --use-pallas auto (resolves to the partitionable XLA path "
+            "on a mesh) or drop the mesh flags."
+        )
     attack = AttackConfig(
         patch_budget=args.patch_budget,
         targeted=args.targeted,
@@ -64,6 +78,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         structured=args.structured,
         eps=args.epsilon,
         num_patch=args.num_patch,
+        use_pallas=args.use_pallas,
     )
     return ExperimentConfig(
         dataset=args.dataset,
@@ -81,8 +96,10 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         img_size=args.img_size,
         mesh_data=args.mesh_data,
         mesh_mask=args.mesh_mask,
+        metrics_log=not args.no_metrics_log,
+        trace_dir=args.trace_dir,
         attack=attack,
-        defense=DefenseConfig(),
+        defense=DefenseConfig(use_pallas=args.use_pallas),
     )
 
 

@@ -101,6 +101,11 @@ class ExperimentConfig:
     mesh_data: int = 1
     mesh_mask: int = 1
 
+    # Observability (SURVEY.md §5): structured metrics JSONL under the
+    # results dir, optional jax.profiler trace dir.
+    metrics_log: bool = True
+    trace_dir: str = ""
+
     attack: AttackConfig = dataclasses.field(default_factory=AttackConfig)
     defense: DefenseConfig = dataclasses.field(default_factory=DefenseConfig)
 

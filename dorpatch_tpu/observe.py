@@ -1,0 +1,152 @@
+"""Observability: structured attack metrics, step timing, and device traces.
+
+The reference's only observability is tqdm plus a print of the loss breakdown
+every 20 iterations (`/root/reference/attack.py:318-330`) and per-run result
+prints (`main.py:186-187`). Here that becomes a subsystem:
+
+- `AttackMetricsLogger` — consumes the attack's on-device metrics vector at
+  every jitted block boundary (`DorPatch.on_block_end`) and appends JSONL
+  records (one file per experiment, under the results dir), with an optional
+  console mirror of the reference's periodic loss-breakdown line. Metrics
+  stay on device between block boundaries — logging cost is one [8]-vector
+  transfer per block, not per step.
+- `StepTimer` — wall-clock per block -> steps/sec and images/sec series.
+- `trace` — context manager around `jax.profiler` for on-demand TPU traces
+  (tensorboard-viewable), gated so it is a no-op when no trace dir is given.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import IO, Optional
+
+import numpy as np
+
+# Layout of `TrainState.metrics` (see `attack.DorPatch._step`).
+METRIC_NAMES = (
+    "loss",         # total per-image objective, batch mean
+    "loss_adv",     # CW margin over sampled masks, mean
+    "loss_struc",   # structural TV ratio, mean
+    "group_lasso",  # stage-0 group-lasso, mean
+    "density",      # stage-0 density variance, mean
+    "masked_acc",   # victim accuracy on masked EOT batch (1.0 = attack losing)
+    "l2",           # ||delta||_2 batch mean
+    "n_failed",     # failure-set size (masks the attack currently loses to)
+)
+
+
+class AttackMetricsLogger:
+    """JSONL metrics sink for `DorPatch.on_block_end`.
+
+    Each record: `{"ts": ..., "batch": ..., "stage": 0|1, "step": ...,
+    "stopped": ..., <METRIC_NAMES>...}`. Use as
+    `attack.on_block_end = logger.on_block_end` (optionally after
+    `logger.set_batch(i)`), or chain from an existing callback.
+    """
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        echo_every: int = 0,
+        clock=time.time,
+    ):
+        self.path = path
+        self.echo_every = echo_every
+        self._clock = clock
+        self._batch = 0
+        self._fh: Optional[IO[str]] = None
+        self.history = []
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+
+    def set_batch(self, batch_id: int) -> None:
+        self._batch = batch_id
+
+    def on_block_end(self, stage: int, step: int, info: dict) -> None:
+        vals = np.asarray(info["metrics"], dtype=np.float64)
+        rec = {
+            "ts": round(self._clock(), 3),
+            "batch": self._batch,
+            "stage": int(stage),
+            "step": int(step),
+            "stopped": bool(info.get("stopped", False)),
+        }
+        rec.update({k: float(v) for k, v in zip(METRIC_NAMES, vals)})
+        self.history.append(rec)
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec) + "\n")
+        if self.echo_every and (step % self.echo_every == 0 or rec["stopped"]):
+            # the reference's periodic loss breakdown (`attack.py:318-330`)
+            print(
+                f"[batch {self._batch} stage {stage} iter {step}] "
+                f"loss {rec['loss']:.4f} (adv {rec['loss_adv']:.4f}, "
+                f"struct {rec['loss_struc']:.4f}, gl {rec['group_lasso']:.5f}, "
+                f"density {rec['density']:.5f}) l2 {rec['l2']:.2f} "
+                f"masked-acc {rec['masked_acc']:.2f} "
+                f"failures {rec['n_failed']:.0f}",
+                flush=True,
+            )
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class StepTimer:
+    """Wall-clock series over jitted blocks -> throughput summary."""
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._t0 = None
+        self.block_seconds = []
+
+    def start(self) -> None:
+        self._t0 = self._clock()
+
+    def stop(self) -> float:
+        if self._t0 is None:
+            raise RuntimeError("StepTimer.stop() before start()")
+        dt = self._clock() - self._t0
+        self._t0 = None
+        self.block_seconds.append(dt)
+        return dt
+
+    def summary(self, steps_per_block: int, batch: int) -> dict:
+        total = float(sum(self.block_seconds))
+        n_steps = steps_per_block * len(self.block_seconds)
+        return {
+            "blocks": len(self.block_seconds),
+            "total_seconds": round(total, 3),
+            "steps_per_sec": round(n_steps / total, 3) if total else 0.0,
+            "images_per_sec": round(n_steps * batch / total, 3) if total else 0.0,
+        }
+
+
+@contextlib.contextmanager
+def trace(trace_dir: Optional[str]):
+    """`jax.profiler` trace scope; no-op when `trace_dir` is falsy.
+
+    Produces a tensorboard-loadable trace of every XLA computation launched
+    in the scope — the rebuild's answer to the reference's absent profiling
+    (SURVEY.md §5)."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

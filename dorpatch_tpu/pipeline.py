@@ -10,6 +10,7 @@ The jax path is the product; per-batch flow:
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List
 
@@ -17,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dorpatch_tpu import losses, metrics, parallel
+from dorpatch_tpu import losses, metrics, observe, parallel
 from dorpatch_tpu.artifacts import ArtifactStore, results_path
 from dorpatch_tpu.attack import DorPatch
 from dorpatch_tpu.config import ExperimentConfig
@@ -50,6 +51,10 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
     rng = np.random.default_rng(cfg.seed)
     victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size)
     store = ArtifactStore(results_path(cfg))
+    logger = observe.AttackMetricsLogger(
+        path=os.path.join(store.result_dir, "metrics.jsonl") if cfg.metrics_log else None,
+        echo_every=cfg.attack.report_interval if verbose else 0,
+    )
     mesh = None
     if cfg.mesh_data * cfg.mesh_mask > 1:
         mesh = parallel.make_mesh(cfg.mesh_data, cfg.mesh_mask)
@@ -60,6 +65,7 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
     else:
         defenses = build_defenses(victim.apply, cfg.img_size, cfg.defense)
         attack = DorPatch(victim.apply, victim.params, victim.num_classes, cfg.attack)
+    attack.on_block_end = logger.on_block_end
 
     preds_list: List[np.ndarray] = []
     y_list: List[np.ndarray] = []
@@ -71,91 +77,100 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
         cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size, cfg.seed,
         synthetic=cfg.synthetic_data,
     )
-    for i, (x_np, y_np) in enumerate(batches):
-        if i == cfg.num_batches:  # the reference's hard batch cap (`main.py:84`)
-            break
-        t0 = time.time()
-        x = jnp.asarray(x_np)
+    timer = observe.StepTimer()
+    generated_images = 0
+    with observe.trace(cfg.trace_dir), logger:
+        for i, (x_np, y_np) in enumerate(batches):
+            if i == cfg.num_batches:  # the reference's hard batch cap (`main.py:84`)
+                break
+            t0 = time.time()
+            logger.set_batch(i)
+            x = jnp.asarray(x_np)
 
-        # keep only correctly-classified images (`main.py:91-99`)
-        preds = np.asarray(jnp.argmax(victim.apply(victim.params, x), -1))
-        if cfg.synthetic_data:
-            # synthetic labels are the model's own clean predictions, so the
-            # correctness filter is non-degenerate without a trained victim
-            y_np = preds.copy()
-        correct = preds == y_np
-        if correct.sum() == 0:
-            continue
-        x = x[jnp.asarray(correct)]
-        y_np = y_np[correct]
-        preds = preds[correct]
-        if mesh is not None:
-            # the correctness filter makes the surviving batch size dynamic;
-            # shard it over the data axis when it divides, else replicate
-            # (per-image state is tiny next to the EOT activation batch)
-            try:
-                x = parallel.place_batch(mesh, x)
-            except ValueError:
-                x = jax.device_put(x, parallel.replicated(mesh))
+            # keep only correctly-classified images (`main.py:91-99`)
+            preds = np.asarray(jnp.argmax(victim.apply(victim.params, x), -1))
+            if cfg.synthetic_data:
+                # synthetic labels are the model's own clean predictions, so the
+                # correctness filter is non-degenerate without a trained victim
+                y_np = preds.copy()
+            correct = preds == y_np
+            if correct.sum() == 0:
+                continue
+            x = x[jnp.asarray(correct)]
+            y_np = y_np[correct]
+            preds = preds[correct]
+            if mesh is not None:
+                # the correctness filter makes the surviving batch size dynamic;
+                # shard it over the data axis when it divides, else replicate
+                # (per-image state is tiny next to the EOT activation batch)
+                try:
+                    x = parallel.place_batch(mesh, x)
+                except ValueError:
+                    x = jax.device_put(x, parallel.replicated(mesh))
 
-        cached = store.load_patch(i)
-        if cached is not None:
-            adv_mask, adv_pattern = map(jnp.asarray, cached)
-            if cfg.attack.targeted:
-                # recover the target by re-running the stage-0 patch
-                # (`main.py:108-118`)
-                s0 = store.load_stage0(i)
-                if s0 is None:
-                    raise FileNotFoundError(
-                        f"targeted resume for batch {i} needs the shared "
-                        f"stage-0 artifacts in {store.parent_dir}; they were "
-                        "removed — delete the per-budget patch files too to "
-                        "regenerate"
-                    )
-                delta0 = losses.l2_project(
-                    jnp.asarray(s0[0]), jnp.asarray(s0[1]), x, cfg.attack.eps)
-                target = np.asarray(
-                    jnp.argmax(victim.apply(victim.params, x + delta0), -1))
-                target_list.append(target)
-        else:
-            if cfg.attack.targeted:
-                target = _random_targets(rng, y_np, victim.num_classes)
-                target_list.append(target)
-                y_attack = jnp.asarray(target)
+            cached = store.load_patch(i)
+            if cached is not None:
+                adv_mask, adv_pattern = map(jnp.asarray, cached)
+                if cfg.attack.targeted:
+                    # recover the target by re-running the stage-0 patch
+                    # (`main.py:108-118`)
+                    s0 = store.load_stage0(i)
+                    if s0 is None:
+                        raise FileNotFoundError(
+                            f"targeted resume for batch {i} needs the shared "
+                            f"stage-0 artifacts in {store.parent_dir}; they were "
+                            "removed — delete the per-budget patch files too to "
+                            "regenerate"
+                        )
+                    delta0 = losses.l2_project(
+                        jnp.asarray(s0[0]), jnp.asarray(s0[1]), x, cfg.attack.eps)
+                    target = np.asarray(
+                        jnp.argmax(victim.apply(victim.params, x + delta0), -1))
+                    target_list.append(target)
             else:
-                y_attack = None
-            result = attack.generate(
-                x, y=y_attack, targeted=cfg.attack.targeted,
-                key=jax.random.PRNGKey(cfg.seed + i), store=store, batch_id=i,
-            )
-            adv_mask, adv_pattern = result.adv_mask, result.adv_pattern
-            store.save_patch(i, np.asarray(adv_mask), np.asarray(adv_pattern))
+                if cfg.attack.targeted:
+                    target = _random_targets(rng, y_np, victim.num_classes)
+                    target_list.append(target)
+                    y_attack = jnp.asarray(target)
+                else:
+                    y_attack = None
+                timer.start()
+                result = attack.generate(
+                    x, y=y_attack, targeted=cfg.attack.targeted,
+                    key=jax.random.PRNGKey(cfg.seed + i), store=store, batch_id=i,
+                )
+                jax.block_until_ready(result.adv_pattern)
+                timer.stop()
+                generated_images += int(x.shape[0])
+                adv_mask, adv_pattern = result.adv_mask, result.adv_pattern
+                store.save_patch(i, np.asarray(adv_mask), np.asarray(adv_pattern))
 
-        delta = losses.l2_project(adv_mask, adv_pattern, x, cfg.attack.eps)
-        adv_x = x + delta
+            delta = losses.l2_project(adv_mask, adv_pattern, x, cfg.attack.eps)
+            adv_x = x + delta
 
-        # PatchCleanser evaluation with record cache (`main.py:144-153`);
-        # a cache from a different defense bank (wrong per-image record
-        # count) is recomputed rather than silently reused
-        recs = store.load_pc_records(i)
-        if recs is not None and any(len(r) != len(defenses) for r in recs):
-            recs = None
-        if recs is None:
-            per_defense = [
-                d.robust_predict(victim.params, adv_x, victim.num_classes)
-                for d in defenses
-            ]
-            # records_batch[img][defense], the reference's nesting
-            recs = [list(r) for r in zip(*per_defense)]
-            store.save_pc_records(i, recs)
+            # PatchCleanser evaluation with record cache (`main.py:144-153`);
+            # a cache from a different defense bank (wrong per-image record
+            # count) is recomputed rather than silently reused
+            recs = store.load_pc_records(i)
+            if recs is not None and any(len(r) != len(defenses) for r in recs):
+                recs = None
+            if recs is None:
+                per_defense = [
+                    d.robust_predict(victim.params, adv_x, victim.num_classes)
+                    for d in defenses
+                ]
+                # records_batch[img][defense], the reference's nesting
+                recs = [list(r) for r in zip(*per_defense)]
+                store.save_pc_records(i, recs)
 
-        preds_list.append(preds)
-        y_list.append(y_np)
-        preds_adv_list.append(
-            np.asarray(jnp.argmax(victim.apply(victim.params, adv_x), -1)))
-        records.extend(recs)
-        if verbose:
-            print(f"batch {i}: {len(y_np)} imgs in {time.time() - t0:.1f}s", flush=True)
+            preds_list.append(preds)
+            y_list.append(y_np)
+            preds_adv_list.append(
+                np.asarray(jnp.argmax(victim.apply(victim.params, adv_x), -1)))
+            records.extend(recs)
+            if verbose:
+                print(f"batch {i}: {len(y_np)} imgs in {time.time() - t0:.1f}s",
+                      flush=True)
 
     if not preds_list:
         empty = {"clean_accuracy": 0.0, "robust_accuracy": 0.0,
@@ -175,6 +190,11 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
     m = metrics.compute_metrics(
         preds_clean, y_all, preds_adv, [d.result for d in defenses], targets)
     m["evaluated_images"] = int(len(y_all))
+    if timer.block_seconds:
+        # per-generate wall clock (each "block" is one attack.generate call)
+        m["attack_seconds"] = timer.block_seconds
+        m["attack_images_per_sec"] = round(
+            generated_images / sum(timer.block_seconds), 4)
     m["report"] = metrics.report_line(m)
     if verbose:
         print(m["report"])

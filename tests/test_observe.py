@@ -1,0 +1,69 @@
+"""Observability subsystem: metrics JSONL sink, step timer, trace no-op."""
+
+import json
+
+import numpy as np
+
+from dorpatch_tpu import observe
+
+
+def _info(vals, stopped=False):
+    return {"metrics": np.asarray(vals, np.float32), "stopped": stopped}
+
+
+def test_metrics_logger_writes_jsonl(tmp_path):
+    path = tmp_path / "m" / "metrics.jsonl"
+    clock = iter([100.0, 101.0]).__next__
+    with observe.AttackMetricsLogger(str(path), clock=clock) as logger:
+        logger.set_batch(3)
+        logger.on_block_end(0, 20, _info(range(8)))
+        logger.on_block_end(1, 40, _info(range(8, 16), stopped=True))
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["batch"] == 3 and lines[0]["stage"] == 0
+    assert lines[0]["loss"] == 0.0 and lines[0]["n_failed"] == 7.0
+    assert lines[1]["stage"] == 1 and lines[1]["stopped"] is True
+    assert lines[1]["masked_acc"] == 13.0
+    assert lines[0]["ts"] == 100.0
+
+
+def test_metrics_logger_echo_cadence(capsys):
+    logger = observe.AttackMetricsLogger(path=None, echo_every=40)
+    logger.on_block_end(0, 20, _info(range(8)))       # not on cadence
+    logger.on_block_end(0, 40, _info(range(8)))       # on cadence
+    logger.on_block_end(0, 50, _info(range(8), True)) # stopped -> always
+    out = capsys.readouterr().out
+    assert out.count("iter") == 2
+    assert len(logger.history) == 3
+
+
+def test_metrics_logger_appends_across_instances(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    with observe.AttackMetricsLogger(path) as a:
+        a.on_block_end(0, 1, _info(range(8)))
+    with observe.AttackMetricsLogger(path) as b:
+        b.on_block_end(0, 2, _info(range(8)))
+    assert len(open(path).read().splitlines()) == 2
+
+
+def test_step_timer_summary():
+    times = iter([0.0, 2.0, 2.0, 4.0]).__next__
+    t = observe.StepTimer(clock=times)
+    t.start(); t.stop()
+    t.start(); t.stop()
+    s = t.summary(steps_per_block=10, batch=4)
+    assert s == {"blocks": 2, "total_seconds": 4.0,
+                 "steps_per_sec": 5.0, "images_per_sec": 20.0}
+
+
+def test_trace_noop_without_dir():
+    with observe.trace(""):
+        pass
+    with observe.trace(None):
+        pass
+
+
+def test_metric_names_match_attack_vector_width():
+    from dorpatch_tpu.attack import DorPatch  # noqa: F401 (import side-check)
+
+    assert len(observe.METRIC_NAMES) == 8
