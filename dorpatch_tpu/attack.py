@@ -131,6 +131,8 @@ class DorPatch:
     config: AttackConfig = dataclasses.field(default_factory=AttackConfig)
     remat: bool = True
     on_block_end: Optional[Callable[[int, int, dict], None]] = None
+    # optional CarryCheckpointer: mid-stage crash recovery (checkpoint.py)
+    checkpointer: Optional[Any] = None
 
     def __post_init__(self):
         cfg = self.config
@@ -402,7 +404,10 @@ class DorPatch:
         best_pattern = jnp.where(never, state.adv_pattern, state.best_pattern)
         return best_mask, best_pattern
 
-    def _run_stage(self, stage: int, state: TrainState, x, local_var_x, universe) -> TrainState:
+    def _run_stage(
+        self, stage: int, state: TrainState, x, local_var_x, universe,
+        start_iter: int = 0, stage0_artifacts=None,
+    ) -> TrainState:
         cfg = self.config
         img_size = x.shape[1]
         n_universe = universe.shape[0]
@@ -410,7 +415,10 @@ class DorPatch:
         total = cfg.max_iterations
         block = self._get_block(stage, img_size, interval)
 
-        i = 0
+        if bool(state.stopped):
+            return state  # e.g. resumed from a snapshot taken at early stop
+
+        i = start_iter
         while i < total:
             # full failure sweep at every sweep_interval boundary (incl. i=0,
             # `attack.py:187-190`)
@@ -443,6 +451,11 @@ class DorPatch:
                 )
                 state = self._reset_schedules(state, n_universe)
 
+            # snapshot before the user callback, so a crash anywhere after
+            # the block computation resumes from this block
+            if self.checkpointer is not None:
+                s0 = stage0_artifacts or (None, None)
+                self.checkpointer.save(stage, i, state, s0[0], s0[1])
             if self.on_block_end is not None:
                 self.on_block_end(stage, i, {
                     "metrics": np.asarray(state.metrics),
@@ -486,14 +499,29 @@ class DorPatch:
         k0, k1 = jax.random.split(key)
         state = self._init_state(k0, x, y, targeted, universe.shape[0])
 
+        # mid-stage crash recovery: restore the latest carry snapshot, if any
+        resume = None
+        if self.checkpointer is not None:
+            resume = self.checkpointer.restore(
+                state, (state.adv_mask, state.adv_pattern))
+
         # ---- stage 0: importance map (resumable from the shared parent dir) ----
         cached = store.load_stage0(batch_id) if store is not None else None
-        if cached is not None:
+        if resume is not None and resume.stage == 1:
+            # carry snapshot is already past stage 0; y/targeted/coefficients
+            # all live inside the restored state
+            stage0_mask = jnp.asarray(resume.stage0_mask)
+            stage0_pattern = jnp.asarray(resume.stage0_pattern)
+        elif cached is not None:
             stage0_mask, stage0_pattern = (jnp.asarray(cached[0]), jnp.asarray(cached[1]))
             targeted_now = targeted
             coeff_struct_carry = jnp.asarray(cfg.structured, jnp.float32)
         else:
-            state = self._run_stage(0, state, x, local_var_x, universe)
+            start0 = 0
+            if resume is not None and resume.stage == 0:
+                state, start0 = resume.state, resume.iteration
+            state = self._run_stage(0, state, x, local_var_x, universe,
+                                    start_iter=start0)
             stage0_mask, stage0_pattern = self._finalize_best(state)
             targeted_now = state.targeted  # [B] per-image flags after stage 0
             # the reference mutates `structured` in place, so stage 1 inherits
@@ -503,26 +531,32 @@ class DorPatch:
                 store.save_stage0(batch_id, np.asarray(stage0_mask), np.asarray(stage0_pattern))
 
         # ---- stage 1 init (`attack.py:143-165`) ----
-        delta = losses.l2_project(stage0_mask, stage0_pattern, x, cfg.eps)
-        adv_x = x + delta
-        targeted_vec = jnp.broadcast_to(jnp.asarray(targeted_now, bool), (x.shape[0],))
-        targeted_vec = targeted_vec | state.targeted
-        preds = jnp.argmax(self.apply_fn(self.params, adv_x), axis=-1)
-        newly = (~targeted_vec) & (preds != state.y)
-        y_cur = jnp.where(newly, preds, state.y)
-        targeted_vec = targeted_vec | newly
+        if resume is not None and resume.stage == 1:
+            state, start1 = resume.state, resume.iteration
+        else:
+            start1 = 0
+            delta = losses.l2_project(stage0_mask, stage0_pattern, x, cfg.eps)
+            adv_x = x + delta
+            targeted_vec = jnp.broadcast_to(jnp.asarray(targeted_now, bool), (x.shape[0],))
+            targeted_vec = targeted_vec | state.targeted
+            preds = jnp.argmax(self.apply_fn(self.params, adv_x), axis=-1)
+            newly = (~targeted_vec) & (preds != state.y)
+            y_cur = jnp.where(newly, preds, state.y)
+            targeted_vec = targeted_vec | newly
 
-        hard_mask = patch_selection(stage0_mask, cfg.patch_budget, cfg.basic_unit)
-        state = self._init_state(k1, x, y_cur, False, universe.shape[0])
-        state = state._replace(
-            adv_mask=hard_mask,
-            adv_pattern=adv_x,
-            best_mask=hard_mask,
-            y=jnp.asarray(y_cur, jnp.int32),
-            targeted=targeted_vec,
-            coeff_struct=coeff_struct_carry,
-        )
-        state = self._run_stage(1, state, x, local_var_x, universe)
+            hard_mask = patch_selection(stage0_mask, cfg.patch_budget, cfg.basic_unit)
+            state = self._init_state(k1, x, y_cur, False, universe.shape[0])
+            state = state._replace(
+                adv_mask=hard_mask,
+                adv_pattern=adv_x,
+                best_mask=hard_mask,
+                y=jnp.asarray(y_cur, jnp.int32),
+                targeted=targeted_vec,
+                coeff_struct=coeff_struct_carry,
+            )
+        state = self._run_stage(1, state, x, local_var_x, universe,
+                                start_iter=start1,
+                                stage0_artifacts=(stage0_mask, stage0_pattern))
         best_mask, best_pattern = self._finalize_best(state)
 
         return AttackResult(

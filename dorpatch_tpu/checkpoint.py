@@ -1,0 +1,114 @@
+"""Mid-optimization checkpointing of the attack carry (crash recovery).
+
+The reference's only resume granularity is whole artifacts: a crash loses an
+entire 5000-iteration stage (`/root/reference/main.py:101-118`,
+`attack.py:134-141` — artifact files exist only *after* a stage completes;
+SURVEY.md §5). Here the jitted optimizer's full carry — the `TrainState`
+pytree holding the iterates, best-so-far checkpoints, failure set, adaptive
+coefficients, lr/patience schedules and PRNG key — is snapshotted with orbax
+at sweep-block boundaries, together with the stage id, iteration count, and
+the stage-0 artifacts stage 1 depends on. Restoring reproduces the exact
+on-device state, so a killed run continues from the last block instead of
+the last stage.
+
+Orbax is the TPU-native choice: async-capable, atomic renames, works with
+sharded jax.Arrays on meshes, and the restore takes a concrete template so
+arrays come back with the template's sharding/placement.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, NamedTuple, Optional
+
+import jax
+
+
+class CarryCheckpoint(NamedTuple):
+    """A restored mid-stage snapshot."""
+
+    stage: int
+    iteration: int
+    state: Any                    # TrainState pytree
+    stage0_mask: Optional[jax.Array]     # None while still in stage 0
+    stage0_pattern: Optional[jax.Array]
+
+
+class CarryCheckpointer:
+    """Orbax CheckpointManager wrapper for the attack carry.
+
+    One instance per attack invocation (e.g. per experiment batch). `save`
+    keeps the newest `max_to_keep` snapshots; `restore` returns the latest
+    or None. `clear` removes all snapshots (call after a successful
+    generate so stale carries never leak into the next run).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 2):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=False,  # blocks are seconds apart
+            ),
+        )
+
+    def save(self, stage: int, iteration: int, state: Any,
+             stage0_mask=None, stage0_pattern=None) -> None:
+        ocp = self._ocp
+        step = int(iteration)
+        payload = {"state": state}
+        if stage0_mask is not None:
+            payload["stage0"] = {"mask": stage0_mask, "pattern": stage0_pattern}
+        self._mgr.save(
+            stage * 10_000_000 + step,
+            args=ocp.args.Composite(
+                carry=ocp.args.StandardSave(payload),
+                meta=ocp.args.JsonSave({"stage": int(stage), "iteration": step}),
+            ),
+        )
+
+    def restore(self, state_template: Any, stage0_template=None
+                ) -> Optional[CarryCheckpoint]:
+        """Latest snapshot, arrays placed like the (concrete) templates."""
+        ocp = self._ocp
+        latest = self._mgr.latest_step()
+        if latest is None:
+            return None
+        meta = self._mgr.restore(
+            latest, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+        )["meta"]
+        payload_t = {"state": state_template}
+        if meta["stage"] == 1:
+            if stage0_template is None:
+                raise ValueError("stage-1 checkpoint needs a stage-0 template")
+            payload_t["stage0"] = {
+                "mask": stage0_template[0], "pattern": stage0_template[1]}
+        restored = self._mgr.restore(
+            latest, args=ocp.args.Composite(carry=ocp.args.StandardRestore(payload_t))
+        )["carry"]
+        s0 = restored.get("stage0")
+        return CarryCheckpoint(
+            stage=int(meta["stage"]),
+            iteration=int(meta["iteration"]),
+            state=restored["state"],
+            stage0_mask=None if s0 is None else s0["mask"],
+            stage0_pattern=None if s0 is None else s0["pattern"],
+        )
+
+    def clear(self) -> None:
+        for step in list(self._mgr.all_steps()):
+            self._mgr.delete(step)
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

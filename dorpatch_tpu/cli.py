@@ -52,6 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace of the run here")
     p.add_argument("--no-metrics-log", action="store_true",
                    help="disable the structured metrics JSONL in the results dir")
+    p.add_argument("--carry-checkpoints", action="store_true",
+                   help="orbax-checkpoint the optimizer carry every sweep "
+                        "block (mid-stage crash recovery)")
     p.add_argument("--use-pallas", default="auto",
                    choices=["auto", "on", "off", "interpret"],
                    help="fused mask-fill kernel dispatch")
@@ -98,6 +101,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         mesh_mask=args.mesh_mask,
         metrics_log=not args.no_metrics_log,
         trace_dir=args.trace_dir,
+        carry_checkpoints=args.carry_checkpoints,
         attack=attack,
         defense=DefenseConfig(use_pallas=args.use_pallas),
     )

@@ -106,6 +106,10 @@ class ExperimentConfig:
     metrics_log: bool = True
     trace_dir: str = ""
 
+    # Mid-stage orbax checkpoints of the optimizer carry (crash recovery
+    # finer than the reference's per-stage artifacts, SURVEY.md §5).
+    carry_checkpoints: bool = False
+
     attack: AttackConfig = dataclasses.field(default_factory=AttackConfig)
     defense: DefenseConfig = dataclasses.field(default_factory=DefenseConfig)
 

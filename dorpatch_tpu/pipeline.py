@@ -134,12 +134,27 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                     y_attack = jnp.asarray(target)
                 else:
                     y_attack = None
+                ck = None
+                if cfg.carry_checkpoints:
+                    from dorpatch_tpu.checkpoint import CarryCheckpointer
+
+                    ck = CarryCheckpointer(
+                        os.path.join(store.result_dir, f"carry_{i}"))
+                    attack.checkpointer = ck
                 timer.start()
-                result = attack.generate(
-                    x, y=y_attack, targeted=cfg.attack.targeted,
-                    key=jax.random.PRNGKey(cfg.seed + i), store=store, batch_id=i,
-                )
-                jax.block_until_ready(result.adv_pattern)
+                try:
+                    result = attack.generate(
+                        x, y=y_attack, targeted=cfg.attack.targeted,
+                        key=jax.random.PRNGKey(cfg.seed + i), store=store,
+                        batch_id=i,
+                    )
+                    jax.block_until_ready(result.adv_pattern)
+                    if ck is not None:
+                        ck.clear()  # success: stale carries must not leak forward
+                finally:
+                    attack.checkpointer = None
+                    if ck is not None:
+                        ck.close()  # on failure snapshots stay for resume
                 timer.stop()
                 generated_images += int(x.shape[0])
                 adv_mask, adv_pattern = result.adv_mask, result.adv_pattern

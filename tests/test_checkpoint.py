@@ -1,0 +1,106 @@
+"""Carry checkpointing: orbax roundtrip and mid-stage crash recovery."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dorpatch_tpu.attack import DorPatch
+from dorpatch_tpu.checkpoint import CarryCheckpointer
+from dorpatch_tpu.config import AttackConfig
+
+
+def _tiny_attack(cfg, **kw):
+    def apply_fn(params, x):
+        s = x.mean(axis=(1, 2))
+        return jnp.stack([s[:, 0], s[:, 1], s[:, 2], s.sum(-1) / 3.0], -1) * 10
+
+    return DorPatch(apply_fn, None, 4, cfg, remat=False, **kw)
+
+
+def _cfg(**kw):
+    base = dict(sampling_size=4, max_iterations=6, sweep_interval=3,
+                switch_iteration=3, dropout=1, dropout_sizes=(0.06,),
+                basic_unit=4, patch_budget=0.15)
+    base.update(kw)
+    return AttackConfig(**base)
+
+
+def test_carry_roundtrip(tmp_path):
+    atk = _tiny_attack(_cfg())
+    x = jax.random.uniform(jax.random.PRNGKey(0), (1, 16, 16, 3))
+    state = atk._init_state(jax.random.PRNGKey(1), x, jnp.zeros((1,), jnp.int32),
+                            False, 10)
+    with CarryCheckpointer(str(tmp_path / "ck")) as ck:
+        ck.save(0, 3, state)
+        got = ck.restore(state)
+        assert got is not None and (got.stage, got.iteration) == (0, 3)
+        assert got.stage0_mask is None
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            state, got.state)
+
+        # stage-1 snapshot includes the stage-0 artifacts, newest wins
+        ck.save(1, 3, state, state.adv_mask, state.adv_pattern)
+        got1 = ck.restore(state, (state.adv_mask, state.adv_pattern))
+        assert (got1.stage, got1.iteration) == (1, 3)
+        np.testing.assert_array_equal(
+            np.asarray(got1.stage0_mask), np.asarray(state.adv_mask))
+
+
+def test_restore_empty_returns_none(tmp_path):
+    with CarryCheckpointer(str(tmp_path / "empty")) as ck:
+        assert ck.restore(None) is None
+
+
+def test_clear_removes_snapshots(tmp_path):
+    atk = _tiny_attack(_cfg())
+    x = jax.random.uniform(jax.random.PRNGKey(0), (1, 16, 16, 3))
+    state = atk._init_state(jax.random.PRNGKey(1), x, jnp.zeros((1,), jnp.int32),
+                            False, 10)
+    with CarryCheckpointer(str(tmp_path / "ck")) as ck:
+        ck.save(0, 3, state)
+        ck.clear()
+        assert ck.restore(state) is None
+
+
+@pytest.mark.slow
+def test_mid_stage_resume_matches_uninterrupted(tmp_path):
+    """Kill after the first stage-1 block; the resumed run must finish from
+    the snapshot (not restart) and reproduce the uninterrupted result."""
+    x = jax.random.uniform(jax.random.PRNGKey(2), (1, 16, 16, 3)) * 0.3
+    key = jax.random.PRNGKey(3)
+
+    # uninterrupted oracle
+    full = _tiny_attack(_cfg()).generate(x, key=key)
+
+    class Boom(RuntimeError):
+        pass
+
+    blocks_seen = []
+
+    def bomb(stage, i, info):
+        blocks_seen.append((stage, i))
+        if stage == 1 and i == 3:
+            raise Boom()
+
+    ck_dir = str(tmp_path / "carry")
+    atk = _tiny_attack(_cfg(), checkpointer=CarryCheckpointer(ck_dir))
+    atk.on_block_end = bomb
+    with pytest.raises(Boom):
+        atk.generate(x, key=key)
+    atk.checkpointer.close()
+
+    # fresh attack + checkpointer, same inputs: resumes stage 1 from iter 3
+    resumed_blocks = []
+    atk2 = _tiny_attack(_cfg(), checkpointer=CarryCheckpointer(ck_dir))
+    atk2.on_block_end = lambda s, i, info: resumed_blocks.append((s, i))
+    res = atk2.generate(x, key=key)
+    atk2.checkpointer.close()
+
+    assert resumed_blocks and resumed_blocks[0][0] == 1  # no stage-0 rerun
+    assert all(i > 3 or s != 1 for s, i in resumed_blocks) or resumed_blocks[0][1] > 3
+    np.testing.assert_allclose(
+        np.asarray(res.adv_pattern), np.asarray(full.adv_pattern), atol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(res.adv_mask), np.asarray(full.adv_mask))
