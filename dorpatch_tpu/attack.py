@@ -137,6 +137,26 @@ class DorPatch:
     def __post_init__(self):
         cfg = self.config
         fwd = self.apply_fn
+        if cfg.compute_dtype == "bfloat16":
+            # mixed precision, TPU-style: the EOT forward+backward (where all
+            # the FLOPs and bandwidth are) runs in bfloat16 on the MXU, while
+            # the patch iterates, losses, and all adaptive carry state stay
+            # float32 ("master" precision). The signed-grad update only
+            # consumes grad signs, so reduced-precision gradients are well
+            # tolerated. Params are cast once (keeps device placement and
+            # any mesh sharding).
+            params16 = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+                self.params,
+            )
+            base = self.apply_fn
+
+            def fwd(_params, x):
+                return base(params16, x.astype(jnp.bfloat16)).astype(jnp.float32)
+
+        elif cfg.compute_dtype != "float32":
+            raise ValueError(f"compute_dtype={cfg.compute_dtype!r}")
         if self.remat:
             fwd = jax.checkpoint(fwd)
         self._fwd = fwd

@@ -58,6 +58,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--use-pallas", default="auto",
                    choices=["auto", "on", "off", "interpret"],
                    help="fused mask-fill kernel dispatch")
+    p.add_argument("--compute-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="EOT forward+backward precision (carry stays float32)")
     return p
 
 
@@ -82,6 +85,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         eps=args.epsilon,
         num_patch=args.num_patch,
         use_pallas=args.use_pallas,
+        compute_dtype=args.compute_dtype,
     )
     return ExperimentConfig(
         dataset=args.dataset,

@@ -60,6 +60,8 @@ class AttackConfig:
     report_interval: int = 20                  # metrics cadence (attack.py:318)
     adapt_start: int = 200                     # stage-0 coeff adaptation start (attack.py:294)
     use_pallas: str = "auto"                   # fused mask-fill kernel: auto|on|off|interpret
+    compute_dtype: str = "float32"             # EOT fwd+bwd precision: float32|bfloat16
+                                               # (carry/losses stay float32 either way)
 
     @property
     def scale_down(self) -> float:

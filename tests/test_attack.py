@@ -107,6 +107,41 @@ def test_sample_indices_before_start_ignores_failures():
     assert np.asarray(from_fail).sum() == 0
 
 
+# ---------- mixed precision ----------
+
+def test_bfloat16_compute_keeps_f32_carry():
+    """bf16 EOT forward: carry state and metrics stay float32 and finite,
+    and the first-step trajectory tracks the f32 path."""
+    def run(dtype):
+        cfg = AttackConfig(sampling_size=4, dropout=1, dropout_sizes=(0.06,),
+                           basic_unit=4, compute_dtype=dtype)
+        atk = _tiny_attack(cfg)
+        from dorpatch_tpu import masks as masks_lib
+        x = jax.random.uniform(jax.random.PRNGKey(11), (1, 16, 16, 3))
+        universe = jnp.asarray(masks_lib.dropout_universe(16, 1, (0.06,)))
+        state = atk._init_state(jax.random.PRNGKey(12), x,
+                                jnp.zeros((1,), jnp.int32), False,
+                                universe.shape[0])
+        lv = jnp.zeros((1, 16, 16))
+        return state, atk._get_block(1, 16, 2)(state, x, lv, universe)
+
+    init16, s16 = run("bfloat16")
+    init32, s32 = run("float32")
+    assert s16.adv_pattern.dtype == jnp.float32
+    assert s16.metrics.dtype == jnp.float32
+    assert np.isfinite(np.asarray(s16.metrics)).all()
+    # signed-grad updates: the *movement direction from the shared init*
+    # must agree between precisions for nearly all pixels
+    d16 = np.sign(np.asarray(s16.adv_pattern) - np.asarray(init16.adv_pattern))
+    d32 = np.sign(np.asarray(s32.adv_pattern) - np.asarray(init32.adv_pattern))
+    assert (d16 == d32).mean() > 0.9
+
+
+def test_bad_compute_dtype_rejected():
+    with pytest.raises(ValueError):
+        _tiny_attack(AttackConfig(compute_dtype="float16"))
+
+
 # ---------- end-to-end smoke attack ----------
 
 @pytest.mark.slow
