@@ -95,10 +95,14 @@ def child_jax() -> None:
     block_steps = int(os.environ.get("BENCH_BLOCK", "4"))
     reps = int(os.environ.get("BENCH_REPS", "3"))
     # bf16 EOT fwd+bwd is the TPU-native default for the throughput metric;
-    # the torch fp32 baseline measures the reference design, not ours
-    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    # the torch fp32 baseline measures the reference design, not ours. If
+    # this child silently landed on the CPU backend (no accelerator), bf16
+    # would be emulated and *slower* — default to f32 there instead.
+    dtype = os.environ.get("BENCH_DTYPE")
+    if dtype is None:
+        dtype = "float32" if jax.default_backend() == "cpu" else "bfloat16"
 
-    log(f"jax devices: {jax.devices()}")
+    log(f"jax devices: {jax.devices()} dtype: {dtype}")
 
     def run(batch: int) -> float:
         victim = get_model(dataset, arch, img_size=img)

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dorpatch_tpu import losses, metrics, observe, parallel
+from dorpatch_tpu import losses, metrics, observe, parallel, utils
 from dorpatch_tpu.artifacts import ArtifactStore, results_path
 from dorpatch_tpu.attack import DorPatch
 from dorpatch_tpu.config import ExperimentConfig
@@ -48,6 +48,8 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             "reference implementation itself"
         )
 
+    utils.set_global_seed(cfg.seed)       # host RNGs (`utils.py:16-21`)
+    utils.select_device(cfg.device)       # `--device` flag (`utils.py:12-13`)
     rng = np.random.default_rng(cfg.seed)
     victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size)
     store = ArtifactStore(results_path(cfg))

@@ -1,0 +1,41 @@
+"""Seed and device utilities (the reference's `set_device`/`set_random_seed`,
+`/root/reference/utils.py:12-21`, re-thought for JAX).
+
+The reference seeds four global RNGs and sets `CUDA_VISIBLE_DEVICES`. In this
+framework randomness is *threaded*: every stochastic component takes an
+explicit `jax.random` key (the attack carry holds its own split key on
+device), so runs are reproducible under jit by construction. These helpers
+cover the remaining host-side surface: numpy/python RNGs used by data
+shuffling and target sampling, plus a device selector that maps the
+reference's integer device flag onto the jax device list.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def set_global_seed(seed: int = 1234) -> jax.Array:
+    """Seed host-side RNGs (python, numpy legacy) and return the root
+    `PRNGKey` all device-side randomness should be split from."""
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def select_device(device: str = "0") -> Optional[jax.Device]:
+    """The reference's `--device` flag (`utils.py:12-13`): pick the default
+    accelerator by index. Returns None (and changes nothing) when the index
+    does not parse or is out of range — sharded runs address devices through
+    the mesh instead."""
+    try:
+        idx = int(str(device).split(",")[0])
+        dev = jax.devices()[idx]
+    except (ValueError, IndexError):
+        return None
+    jax.config.update("jax_default_device", dev)
+    return dev

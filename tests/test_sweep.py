@@ -1,0 +1,64 @@
+"""Sweep driver + seed/device utils."""
+
+import jax
+import numpy as np
+import pytest
+
+from dorpatch_tpu import utils
+from dorpatch_tpu.config import AttackConfig, ExperimentConfig
+from dorpatch_tpu.sweep import main as sweep_main, run_sweep
+
+
+def test_set_global_seed_reproducible():
+    k1 = utils.set_global_seed(7)
+    a = np.random.uniform(size=3)
+    k2 = utils.set_global_seed(7)
+    b = np.random.uniform(size=3)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
+
+
+def test_select_device():
+    prev = jax.config.jax_default_device
+    try:
+        dev = utils.select_device("0")
+        assert dev is jax.devices()[0]
+        assert utils.select_device("not-a-number") is None
+        assert utils.select_device(str(len(jax.devices()) + 5)) is None
+    finally:
+        jax.config.update("jax_default_device", prev)
+
+
+@pytest.mark.slow
+def test_run_sweep_grid_rows():
+    attack = AttackConfig(
+        sampling_size=4, max_iterations=4, sweep_interval=2,
+        switch_iteration=2, dropout=1, dropout_sizes=(0.06,), basic_unit=4,
+    )
+    cfg = ExperimentConfig(
+        dataset="cifar10", base_arch="resnet18", img_size=32, batch_size=2,
+        synthetic_data=True, attack=attack,
+    )
+    rows = run_sweep(cfg, patch_budgets=(0.1, 0.2), densities=(0.0,),
+                     structureds=(1e-3,), defense_ratio=0.06, verbose=False)
+    assert len(rows) == 2
+    assert [r["patch_budget"] for r in rows] == [0.1, 0.2]
+    for r in rows:
+        assert 0.0 <= r["asr"] <= 100.0
+        assert 0.0 <= r["certified_asr_pc"] <= 100.0
+        assert r["robust_accuracy"] + r["asr"] == pytest.approx(100.0)
+        assert r["images"] >= 1 and r["seconds"] > 0
+        assert np.isfinite(r["mean_l2"])
+
+
+@pytest.mark.slow
+def test_sweep_cli_smoke(capsys):
+    rows = sweep_main([
+        "--synthetic", "--dataset", "cifar10", "--base_arch", "resnet18",
+        "--img-size", "32", "-b", "2", "--max-iterations", "2",
+        "--sampling-size", "4", "--basic-unit", "4",
+        "--patch-budgets", "0.1", "--densities", "0.0",
+    ])
+    assert len(rows) == 1
+    out = capsys.readouterr().out
+    assert '"sweep_points": 1' in out
