@@ -305,14 +305,23 @@ class DorPatch:
         not_decay = jnp.where(early, 0, not_decay)
         stopped = jnp.all(lr < cfg.lr_stop)
 
-        # signed-gradient updates (`attack.py:332-342`); mask only in stage 0
+        # signed-gradient updates (`attack.py:332-342`); mask only in stage 0.
+        # The reference breaks *before* the update once every lr has decayed
+        # below lr_stop (`attack.py:311-316` precede `:332-342`), so the
+        # stopping step keeps its bookkeeping but applies no update.
         lr_b = lr[:, None, None, None]
-        new_pattern = jnp.clip(
-            state.adv_pattern - lr_b * jnp.sign(g_pattern), cfg.clip_min, cfg.clip_max
+        new_pattern = jnp.where(
+            stopped,
+            state.adv_pattern,
+            jnp.clip(state.adv_pattern - lr_b * jnp.sign(g_pattern),
+                     cfg.clip_min, cfg.clip_max),
         )
         if stage == 0:
-            new_mask = jnp.clip(
-                state.adv_mask - lr_b * jnp.sign(g_mask), cfg.clip_min, cfg.clip_max
+            new_mask = jnp.where(
+                stopped,
+                state.adv_mask,
+                jnp.clip(state.adv_mask - lr_b * jnp.sign(g_mask),
+                         cfg.clip_min, cfg.clip_max),
             )
         else:
             new_mask = state.adv_mask

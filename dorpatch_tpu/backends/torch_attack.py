@@ -1,0 +1,536 @@
+"""The torch oracle backend: DorPatch attack + PatchCleanser defense in torch.
+
+This is the executable stand-in for the reference pipeline
+(`/root/reference/attack.py:51-406`, `/root/reference/defenses/PatchCleanser.py:62-118`)
+— the `--backend torch` path that BASELINE.json's acceptance criterion
+(certified-ASR parity of the jax backend vs the torch oracle on fixed
+seeds/images) measures against. It is written to the same semantics as the
+jax attack in `dorpatch_tpu.attack` — including that module's documented
+deliberate repairs of the reference's latent bugs (true batched semantics,
+per-image targeted flags, block-boundary sweeps/switch) — so the two
+backends are comparable step-for-step, not just end-to-end.
+
+Everything host-side is plain torch/numpy (the reference's style); the mask
+geometry comes from `dorpatch_tpu.masks` (shared single source of truth) and
+the double-masking verdict is evaluated with the shared
+`defense.double_masking_verdict` decision logic so any backend difference is
+isolated to model/attack numerics.
+
+Layout: torch-native NCHW. Images `[B,3,H,W]`, patch masks `[B,1,H,W]`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from dorpatch_tpu import masks as masks_lib
+from dorpatch_tpu.config import AttackConfig, DefenseConfig
+
+
+# --------------------------------------------------------------- losses
+
+def cw_margin(logits, labels, targeted, confidence: float = 0.0):
+    """CW margin loss (`/root/reference/attack.py:10-23`), per-sample flag.
+
+    logits `[N,C]`, labels `[N]`, targeted `[N]` bool. Same -1e4 label-slot
+    masking as the jax twin (`losses.cw_margin_switchable`).
+    """
+    onehot = F.one_hot(labels, logits.shape[-1]).to(logits.dtype)
+    real = (logits * onehot).sum(-1)
+    other = ((1.0 - onehot) * logits - onehot * 1e4).amax(-1)
+    margin = torch.where(targeted, other - real, real - other)
+    return torch.clamp(confidence + margin, min=0.0)
+
+
+def local_variance(x):
+    """Directional absolute differences with one-sided gradients
+    (`/root/reference/attack.py:33-39`; jax twin `losses.local_variance`):
+    gradients reach only the shifted operand. x `[B,C,H,W]`."""
+    sg = x.detach()
+    diff_lr = (sg[..., :-1] - x[..., 1:]).abs()
+    grad_lr = torch.cat([diff_lr, sg[..., -1:]], dim=-1)
+    diff_ud = (sg[..., :-1, :] - x[..., 1:, :]).abs()
+    grad_ud = torch.cat([diff_ud, sg[..., -1:, :]], dim=-2)
+    return grad_lr + grad_ud, grad_lr, grad_ud
+
+
+def min_var_weighted_variance(x):
+    """TV weighted by the smaller directional gradient (`attack.py:41-45`)."""
+    lv, grad_lr, grad_ud = local_variance(x)
+    return lv * torch.where(grad_lr > grad_ud, grad_ud, grad_lr)
+
+
+def structural_loss(adv_x, local_var_x):
+    """Per-image structural loss (`attack.py:227-228`): channel-mean weighted
+    TV normalized by the clean image's local variance. Returns `[B]`."""
+    mv = min_var_weighted_variance(adv_x).mean(dim=1)  # [B,H,W]
+    return (mv / (local_var_x + 1e-5)).mean(dim=(1, 2))
+
+
+def window_sum(x, window: int):
+    """Non-overlapping window sums `[B,1,H,W] -> [B,1,H/w,W/w]` (the
+    reference's all-ones stride-w convs, `attack.py:72-80`)."""
+    return F.avg_pool2d(x, window, window) * (window * window)
+
+
+def group_lasso(adv_mask, basic_unit: int):
+    g = window_sum(adv_mask**2, basic_unit)
+    return basic_unit * g.sqrt().sum(dim=(1, 2, 3))
+
+
+def density_loss(adv_mask, window: int):
+    cells = window_sum(adv_mask, window)
+    return cells.flatten(1).var(dim=1, unbiased=True)
+
+
+def l2_project(mask, pattern, x, eps: float):
+    """Soft L2 projection with detached norm (`/root/reference/utils.py:105-110`)."""
+    delta = mask * (pattern - x)
+    norm = delta.detach().flatten(1).norm(dim=1)
+    scale = torch.clamp(eps / norm, max=1.0)
+    return delta * scale[:, None, None, None]
+
+
+def majority_incorrect_label(preds, y, num_classes: int):
+    """Per-image mode of misclassified predictions (`attack.py:106-122`);
+    images with no misclassified sample keep their label and report False
+    (same repair as `attack.majority_incorrect_label`)."""
+    incorrect = preds != y[:, None]
+    counts = (F.one_hot(preds, num_classes) * incorrect[..., None]).sum(dim=1)
+    has_any = incorrect.any(dim=1)
+    mode = counts.argmax(dim=-1).to(y.dtype)  # smallest label on ties
+    return torch.where(has_any, mode, y), has_any
+
+
+def patch_selection(mask, patch_budget: float, basic_unit: int = 7):
+    """Importance map -> hard top-k patch mask (`attack.py:363-382`);
+    mirrors `attack.patch_selection`. mask `[B,1,H,W]` -> binary `[B,1,H,W]`."""
+    b, _, h, w = mask.shape
+    cells = window_sum(mask, basic_unit)[:, 0]  # [B,h',w']
+    hp, wp = cells.shape[1:]
+    flat = cells.reshape(b, -1)
+    k = int(np.floor(h * w * patch_budget / basic_unit**2))
+    vals, idxs = flat.topk(k, dim=1)
+    sel = torch.zeros_like(flat)
+    sel.scatter_(1, idxs, (vals > 0).to(mask.dtype))
+    sel = sel.reshape(b, hp, wp)
+    sel = sel.repeat_interleave(basic_unit, dim=1).repeat_interleave(basic_unit, dim=2)
+    out = torch.zeros((b, h, w), dtype=mask.dtype)
+    out[:, : sel.shape[1], : sel.shape[2]] = sel
+    return out[:, None]
+
+
+# ----------------------------------------------------- mask application
+
+def rects_to_masks(rects: np.ndarray, img_size: int) -> torch.Tensor:
+    """Rasterize rectangle sets `[N,K,4]` -> bool keep-masks `[N,H,W]`
+    (True = kept; the convention of `masks.rasterize`, here in pure numpy:
+    the torch backend must not execute jax ops — in production environments
+    that would initialize, and claim, the accelerator backend)."""
+    rects = np.asarray(rects, np.int32)
+    rows = np.arange(img_size, dtype=np.int32)[:, None]
+    cols = np.arange(img_size, dtype=np.int32)[None, :]
+    r0 = rects[..., 0][..., None, None]
+    r1 = rects[..., 1][..., None, None]
+    c0 = rects[..., 2][..., None, None]
+    c1 = rects[..., 3][..., None, None]
+    occluded = (rows >= r0) & (rows < r1) & (cols >= c0) & (cols < c1)
+    return torch.from_numpy(~occluded.any(axis=-3))
+
+
+def apply_masks(imgs: torch.Tensor, keep: torch.Tensor, fill: float) -> torch.Tensor:
+    """`[B,3,H,W] x [S,H,W] -> [B*S,3,H,W]` gray-filled occlusions
+    (`attack.py:206`, `PatchCleanser.py:99-100`)."""
+    m = keep[None, :, None].to(imgs.dtype)  # [1,S,1,H,W]
+    out = imgs[:, None] * m + fill * (1.0 - m)
+    return out.reshape((-1,) + imgs.shape[1:])
+
+
+def masked_predictions(
+    model, imgs: torch.Tensor, rects: np.ndarray, chunk_size: int, fill: float
+) -> torch.Tensor:
+    """Predictions under every mask: `[B,3,H,W] x [N,K,4] -> [B,N]` int64.
+    Chunked like the reference's sweeps (`PatchCleanser.py:102-112`,
+    `attack.py:384-406`)."""
+    img_size = imgs.shape[-1]
+    preds = []
+    with torch.no_grad():
+        for lo in range(0, rects.shape[0], chunk_size):
+            keep = rects_to_masks(rects[lo: lo + chunk_size], img_size)
+            logits = model(apply_masks(imgs, keep, fill))
+            preds.append(logits.argmax(-1).reshape(imgs.shape[0], -1))
+    return torch.cat(preds, dim=1)
+
+
+# ------------------------------------------------------------- defense
+
+class TorchPatchCleanser:
+    """PatchCleanser double-masking certification on the torch model.
+
+    Computes the [M]/[C(M,2)] prediction tables with the torch model, then
+    hands them to the shared `defense.double_masking_verdict` (pure jnp on
+    CPU) so the decision logic is byte-identical across backends."""
+
+    def __init__(self, model, spec: masks_lib.MaskSpec, config: DefenseConfig):
+        self.model = model
+        self.spec = spec
+        self.config = config
+        singles, doubles = masks_lib.mask_sets(spec)
+        self._num_singles = singles.shape[0]
+        k = max(singles.shape[1], doubles.shape[1])
+        self._rects = np.concatenate(
+            [masks_lib.pad_rects(singles, k), masks_lib.pad_rects(doubles, k)], axis=0
+        )
+        self.result = None
+
+    def robust_predict(self, imgs: torch.Tensor, num_classes: int) -> List:
+        from dorpatch_tpu.defense import (
+            PatchCleanserRecord, double_masking_verdict_np)
+
+        preds = masked_predictions(
+            self.model, imgs, self._rects, self.config.chunk_size,
+            self.config.mask_fill,
+        ).numpy()
+        p1 = preds[:, : self._num_singles]
+        p2 = preds[:, self._num_singles:]
+        pred, certified = double_masking_verdict_np(
+            p1, p2, self._num_singles, num_classes)
+        return [
+            PatchCleanserRecord(int(pred[b]), bool(certified[b]), p1[b], p2[b])
+            for b in range(imgs.shape[0])
+        ]
+
+    def collect(self, records: Sequence):
+        from dorpatch_tpu.defense import PatchCleanserResult
+
+        self.result = PatchCleanserResult(records)
+
+
+def build_torch_defenses(model, img_size: int, config: DefenseConfig):
+    """The 4-radius defense bank (`/root/reference/main.py:61`)."""
+    return [
+        TorchPatchCleanser(
+            model,
+            masks_lib.geometry(img_size, r, config.n_patch, config.num_mask_per_axis),
+            config,
+        )
+        for r in config.ratios
+    ]
+
+
+# -------------------------------------------------------------- attack
+
+class TorchAttackResult(NamedTuple):
+    adv_mask: torch.Tensor     # [B,1,H,W]
+    adv_pattern: torch.Tensor  # [B,3,H,W]
+    y: np.ndarray              # [B] final labels (targets if switched)
+    targeted: np.ndarray       # [B] bool per-image mode after switching
+    stage0_mask: torch.Tensor
+    stage0_pattern: torch.Tensor
+
+
+class _State:
+    """Host-side adaptive state — the torch analog of `attack.TrainState`."""
+
+    def __init__(self, cfg: AttackConfig, b: int, universe_size: int,
+                 y: torch.Tensor, targeted: torch.Tensor):
+        self.lr = np.full((b,), cfg.lr)
+        self.not_decay = np.zeros((b,), np.int64)
+        self.loss_best = np.full((b,), np.inf)
+        self.num_failure = universe_size + 1
+        self.failed = np.zeros((universe_size,), bool)
+        self.coeff_gl = float(cfg.coeff_group_lasso)
+        self.coeff_struct = float(cfg.structured)
+        self.y = y.clone()
+        self.targeted = targeted.clone()
+        self.best_mask = None
+        self.best_pattern = None
+        self.last_preds = None
+        self.stopped = False
+        self.step = 0
+
+
+@dataclasses.dataclass
+class TorchDorPatch:
+    """Two-stage DorPatch attack driving a torch model — the oracle twin of
+    `dorpatch_tpu.attack.DorPatch` (same config, same block/sweep/switch
+    structure, same repairs)."""
+
+    model: Callable[[torch.Tensor], torch.Tensor]
+    num_classes: int
+    config: AttackConfig = dataclasses.field(default_factory=AttackConfig)
+
+    def _sample_indices(self, rng: np.random.Generator, failed: np.ndarray,
+                        step: int):
+        """Failure-biased EOT sampling (`attack.py:192-204`): up to half from
+        the failure set after `failure_sampling_start`, the rest uniform from
+        the universe, each draw without replacement."""
+        cfg = self.config
+        n_mask = failed.shape[0]
+        s = min(cfg.sampling_size, n_mask)
+        half = s // 2
+        fail_ids = np.flatnonzero(failed)
+        n_from_fail = (
+            min(len(fail_ids), half) if step >= cfg.failure_sampling_start else 0
+        )
+        from_fail = np.zeros((s,), bool)
+        idx = np.empty((s,), np.int64)
+        if n_from_fail:
+            idx[:n_from_fail] = rng.choice(fail_ids, n_from_fail, replace=False)
+            from_fail[:n_from_fail] = True
+        idx[n_from_fail:] = rng.choice(n_mask, s - n_from_fail, replace=False)
+        return idx, from_fail
+
+    def _loss(self, adv_mask, adv_pattern, x, local_var_x, keep, state, stage):
+        cfg = self.config
+        b = x.shape[0]
+        s = keep.shape[0]
+        delta = l2_project(adv_mask, adv_pattern, x, cfg.eps)
+        adv_x = x + delta
+        logits = self.model(apply_masks(adv_x, keep, cfg.mask_fill))
+        y_rep = state.y.repeat_interleave(s)
+        targeted_rep = state.targeted.repeat_interleave(s)
+        loss_adv = cw_margin(logits, y_rep, targeted_rep, cfg.confidence).reshape(b, s)
+
+        loss_struc = structural_loss(adv_x, local_var_x)
+        loss = loss_adv.mean(dim=1)
+        if cfg.structured != 0:
+            loss = loss + state.coeff_struct * loss_struc
+        gl = torch.zeros(b)
+        dens = torch.zeros(b)
+        if stage == 0:
+            dens = density_loss(adv_mask, x.shape[-1] // 8)
+            if cfg.density != 0:
+                loss = loss + cfg.density * dens
+            gl = group_lasso(adv_mask, cfg.basic_unit)
+            loss = loss + state.coeff_gl * gl
+        preds = logits.argmax(-1).reshape(b, s)
+        return loss.sum(), dict(
+            loss_adv=loss_adv.detach(), loss_struc=loss_struc.detach(),
+            group_lasso=gl.detach(), preds=preds,
+        )
+
+    def _step(self, state: _State, adv_mask, adv_pattern, x, local_var_x,
+              universe: np.ndarray, stage: int, rng: np.random.Generator,
+              idx: Optional[np.ndarray] = None,
+              from_fail: Optional[np.ndarray] = None):
+        """One optimization step; returns updated (adv_mask, adv_pattern).
+        `idx`/`from_fail` may be injected (tests drive both backends with the
+        same EOT sample). Bookkeeping order matches `attack.DorPatch._step`."""
+        cfg = self.config
+        if idx is None:
+            idx, from_fail = self._sample_indices(rng, state.failed, state.step)
+        keep = rects_to_masks(universe[idx], x.shape[-1])
+
+        adv_mask = adv_mask.detach().requires_grad_(stage == 0)
+        adv_pattern = adv_pattern.detach().requires_grad_(True)
+        total, aux = self._loss(
+            adv_mask, adv_pattern, x, local_var_x, keep, state, stage)
+        total.backward()
+
+        loss_adv = aux["loss_adv"].numpy()
+        success_bs = loss_adv < cfg.success_threshold      # [B,S]
+        mask_success = success_bs.all(axis=0)              # [S]
+
+        # failure-set surgery (`attack.py:259-267`)
+        state.failed[idx[from_fail & mask_success]] = False
+        state.failed[idx[(~from_fail) & (~mask_success)]] = True
+        n_failed = int(state.failed.sum())
+
+        attack_success = bool(success_bs.all())
+        certifiable = n_failed == 0
+
+        loss_target = (aux["group_lasso"] if stage == 0 else aux["loss_struc"]).numpy()
+        if n_failed < state.num_failure:
+            state.loss_best = np.full_like(state.loss_best, np.inf)
+        certify_better = n_failed <= state.num_failure
+        loss_decay = certify_better & (
+            (loss_target - state.loss_best) < -cfg.loss_decay_margin)
+
+        if loss_decay.any():
+            state.num_failure = n_failed
+        state.loss_best = np.where(loss_decay, loss_target, state.loss_best)
+        sel = torch.from_numpy(loss_decay)[:, None, None, None]
+        if stage == 0:
+            state.best_mask = torch.where(sel, adv_mask.detach(), state.best_mask)
+        state.best_pattern = torch.where(sel, adv_pattern.detach(), state.best_pattern)
+        state.not_decay = np.where(loss_decay, 0, state.not_decay + 1)
+
+        # adaptive coefficients (`attack.py:294-303`)
+        grow = attack_success and certifiable
+        factor = cfg.scale_up if grow else 1.0 / cfg.scale_down
+        if stage == 0 and state.step > cfg.adapt_start:
+            state.coeff_gl *= factor
+        else:
+            state.coeff_struct *= factor
+
+        # patience lr decay + early stop (`attack.py:292,305-316`); like the
+        # reference, the stopping step applies no update
+        early = state.not_decay > cfg.patience
+        state.lr = np.where(early, state.lr * cfg.lr_decay, state.lr)
+        state.lr = np.maximum(state.lr, cfg.lr_floor)
+        state.not_decay = np.where(early, 0, state.not_decay)
+        state.last_preds = aux["preds"]
+        state.step += 1
+        if bool((state.lr < cfg.lr_stop).all()):
+            state.stopped = True
+            return adv_mask.detach(), adv_pattern.detach()
+
+        lr_b = torch.from_numpy(state.lr).float()[:, None, None, None]
+        new_pattern = (adv_pattern.detach() - lr_b * adv_pattern.grad.sign()).clamp(
+            cfg.clip_min, cfg.clip_max)
+        if stage == 0:
+            new_mask = (adv_mask.detach() - lr_b * adv_mask.grad.sign()).clamp(
+                cfg.clip_min, cfg.clip_max)
+        else:
+            new_mask = adv_mask.detach()
+        return new_mask, new_pattern
+
+    def sweep_failures(self, adv_mask, adv_pattern, x, state: _State,
+                       universe: np.ndarray) -> np.ndarray:
+        """Full-universe failure sweep (`attack.py:384-406`)."""
+        cfg = self.config
+        with torch.no_grad():
+            delta = l2_project(adv_mask, adv_pattern, x, cfg.eps)
+            preds = masked_predictions(
+                self.model, x + delta, universe,
+                min(cfg.sampling_size, universe.shape[0]), cfg.mask_fill,
+            ).numpy()
+        hit = preds == state.y.numpy()[:, None]
+        fail = np.where(state.targeted.numpy()[:, None], ~hit, hit)
+        return fail.any(axis=0)
+
+    def _run_stage(self, stage: int, state: _State, adv_mask, adv_pattern,
+                   x, local_var_x, universe, rng):
+        """Block/sweep/switch structure mirroring `attack.DorPatch._run_stage`:
+        full sweep at every `sweep_interval` boundary, untargeted->targeted
+        switch at the first boundary past `switch_iteration`."""
+        cfg = self.config
+        interval = cfg.sweep_interval
+        total = cfg.max_iterations
+        i = 0
+        while i < total:
+            state.failed = self.sweep_failures(
+                adv_mask, adv_pattern, x, state, universe)
+            n_steps = min(interval, total - i)
+            for _ in range(n_steps):
+                adv_mask, adv_pattern = self._step(
+                    state, adv_mask, adv_pattern, x, local_var_x, universe,
+                    stage, rng)
+                if state.stopped:
+                    break
+            i += n_steps
+            if (
+                stage == 0
+                and i >= cfg.switch_iteration
+                and i - n_steps < cfg.switch_iteration
+                and not bool(state.targeted.all())
+            ):
+                y_new, has_target = majority_incorrect_label(
+                    state.last_preds, state.y, self.num_classes)
+                switch = has_target & (~state.targeted)
+                state.targeted = state.targeted | switch
+                state.y = torch.where(switch, y_new, state.y)
+                state.lr = np.full_like(state.lr, cfg.lr)
+                state.loss_best = np.full_like(state.loss_best, np.inf)
+                state.not_decay = np.zeros_like(state.not_decay)
+                state.num_failure = universe.shape[0] + 1
+            if state.stopped:
+                break
+        return adv_mask, adv_pattern
+
+    def _finalize_best(self, state: _State, adv_mask, adv_pattern):
+        never = torch.from_numpy(np.isinf(state.loss_best))[:, None, None, None]
+        best_mask = torch.where(never, adv_mask, state.best_mask)
+        best_pattern = torch.where(never, adv_pattern, state.best_pattern)
+        return best_mask, best_pattern
+
+    def generate(
+        self,
+        x: torch.Tensor,
+        y: Optional[torch.Tensor] = None,
+        targeted: bool = False,
+        seed: int = 0,
+        store=None,
+        batch_id: int = 0,
+    ) -> TorchAttackResult:
+        """Run the full two-stage attack (`/root/reference/attack.py:51-361`);
+        same store contract as the jax `DorPatch.generate`."""
+        cfg = self.config
+        b = x.shape[0]
+        img_size = x.shape[-1]
+        universe = masks_lib.dropout_universe(
+            img_size, cfg.dropout, cfg.dropout_sizes)
+        rng = np.random.default_rng(seed)
+        gen = torch.Generator().manual_seed(seed)
+        with torch.no_grad():
+            if y is None:
+                y = self.model(x).argmax(-1)
+            local_var_x = local_variance(x)[0].mean(dim=1)
+
+        targeted_vec = torch.full((b,), bool(targeted), dtype=torch.bool)
+        y = y.to(torch.int64)
+
+        def fresh_state():
+            st = _State(cfg, b, universe.shape[0], y, targeted_vec)
+            st.best_mask = torch.zeros((b, 1, img_size, img_size))
+            st.best_pattern = torch.zeros((b, 3, img_size, img_size))
+            return st
+
+        # ---- stage 0: importance map (shared-parent-dir resumable) ----
+        cached = store.load_stage0(batch_id) if store is not None else None
+        if cached is not None:
+            stage0_mask = torch.from_numpy(
+                np.moveaxis(np.asarray(cached[0]), -1, 1).copy())
+            stage0_pattern = torch.from_numpy(
+                np.moveaxis(np.asarray(cached[1]), -1, 1).copy())
+            state = fresh_state()
+            coeff_struct_carry = float(cfg.structured)
+        else:
+            state = fresh_state()
+            adv_mask = torch.rand((b, 1, img_size, img_size), generator=gen)
+            adv_pattern = torch.rand((b, 3, img_size, img_size), generator=gen)
+            adv_mask, adv_pattern = self._run_stage(
+                0, state, adv_mask, adv_pattern, x, local_var_x, universe, rng)
+            stage0_mask, stage0_pattern = self._finalize_best(
+                state, adv_mask, adv_pattern)
+            coeff_struct_carry = state.coeff_struct
+            if store is not None:
+                store.save_stage0(
+                    batch_id,
+                    np.moveaxis(stage0_mask.numpy(), 1, -1),
+                    np.moveaxis(stage0_pattern.numpy(), 1, -1),
+                )
+
+        # ---- stage 1: pattern refinement on the frozen hard mask ----
+        with torch.no_grad():
+            delta = l2_project(stage0_mask, stage0_pattern, x, cfg.eps)
+            adv_x = x + delta
+            preds = self.model(adv_x).argmax(-1)
+        targeted_vec = state.targeted.clone()
+        newly = (~targeted_vec) & (preds != state.y)
+        y_cur = torch.where(newly, preds, state.y)
+        targeted_vec = targeted_vec | newly
+
+        hard_mask = patch_selection(stage0_mask, cfg.patch_budget, cfg.basic_unit)
+        state1 = _State(cfg, b, universe.shape[0], y_cur, targeted_vec)
+        state1.best_mask = hard_mask.clone()
+        state1.best_pattern = torch.zeros_like(adv_x)
+        state1.coeff_struct = coeff_struct_carry
+        adv_mask, adv_pattern = self._run_stage(
+            1, state1, hard_mask, adv_x.clone(), x, local_var_x, universe, rng)
+        best_mask, best_pattern = self._finalize_best(state1, adv_mask, adv_pattern)
+
+        return TorchAttackResult(
+            adv_mask=best_mask,
+            adv_pattern=best_pattern,
+            y=state1.y.numpy(),
+            targeted=state1.targeted.numpy(),
+            stage0_mask=stage0_mask,
+            stage0_pattern=stage0_pattern,
+        )

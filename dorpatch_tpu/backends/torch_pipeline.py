@@ -1,0 +1,192 @@
+"""The `--backend torch` experiment driver: the reference pipeline
+(`/root/reference/main.py:44-188`) executed with the torch oracle models.
+
+Shares everything shareable with the jax pipeline — `ArtifactStore` (so
+torch- and jax-produced artifacts interchange on disk), `data` batches,
+`metrics`, mask geometry, and record types — and never executes a jax op
+(in production environments any jnp op initializes, and claims, the
+accelerator backend; the torch oracle must be runnable alongside it).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, List
+
+import numpy as np
+import torch
+
+from dorpatch_tpu import metrics
+from dorpatch_tpu.artifacts import ArtifactStore, results_path
+from dorpatch_tpu.backends.torch_attack import (
+    TorchDorPatch,
+    build_torch_defenses,
+    l2_project,
+)
+from dorpatch_tpu.backends.torch_models import Normalized, create_torch_model
+from dorpatch_tpu.config import ExperimentConfig
+from dorpatch_tpu.data import dataset_batches
+
+
+def get_torch_victim(cfg: ExperimentConfig) -> torch.nn.Module:
+    """Torch victim with the reference's checkpoint contract
+    (`/root/reference/utils.py:47-63` + `NormModel`): load
+    `<model_dir>/<dataset>/<timm>_cutout2_128_<dataset>.pth` when present,
+    else keep the (seeded) random initialization."""
+    import os
+
+    from dorpatch_tpu.models.registry import checkpoint_path, resolve_arch
+
+    torch.manual_seed(cfg.seed)
+    net = create_torch_model(cfg.base_arch, cfg.num_classes)
+    ckpt = checkpoint_path(cfg.model_dir, cfg.dataset, resolve_arch(cfg.base_arch))
+    if os.path.exists(ckpt):
+        obj = torch.load(ckpt, map_location="cpu", weights_only=True)
+        if isinstance(obj, dict) and "state_dict" in obj:
+            obj = obj["state_dict"]
+        obj = {k.removeprefix("module."): v for k, v in obj.items()}
+        net.load_state_dict(obj)
+    return Normalized(net).eval()
+
+
+def _nchw(x_np: np.ndarray) -> torch.Tensor:
+    return torch.from_numpy(np.moveaxis(x_np, -1, 1).copy()).float()
+
+
+def run_experiment_torch(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
+    """Torch twin of `pipeline.run_experiment`; returns the same metrics dict."""
+    random.seed(cfg.seed)
+    np.random.seed(cfg.seed)
+    torch.manual_seed(cfg.seed)
+    rng = np.random.default_rng(cfg.seed)
+
+    model = get_torch_victim(cfg)
+    store = ArtifactStore(results_path(cfg))
+    defenses = build_torch_defenses(model, cfg.img_size, cfg.defense)
+    attack = TorchDorPatch(model, cfg.num_classes, cfg.attack)
+
+    preds_list: List[np.ndarray] = []
+    y_list: List[np.ndarray] = []
+    preds_adv_list: List[np.ndarray] = []
+    target_list: List[np.ndarray] = []
+    records: List[List] = []
+
+    batches = dataset_batches(
+        cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size, cfg.seed,
+        synthetic=cfg.synthetic_data,
+    )
+    attack_seconds: List[float] = []
+    generated_images = 0
+    for i, (x_np, y_np) in enumerate(batches):
+        if i == cfg.num_batches:  # reference batch cap (`main.py:84`)
+            break
+        t0 = time.time()
+        x = _nchw(x_np)
+
+        with torch.no_grad():
+            preds = model(x).argmax(-1).numpy()
+        if cfg.synthetic_data:
+            y_np = preds.copy()
+        correct = preds == y_np
+        if correct.sum() == 0:
+            continue
+        x = x[torch.from_numpy(correct)]
+        y_np = y_np[correct]
+        preds = preds[correct]
+
+        cached = store.load_patch(i)
+        if cached is not None:
+            adv_mask = _nchw(cached[0])
+            adv_pattern = _nchw(cached[1])
+            if cfg.attack.targeted:
+                s0 = store.load_stage0(i)
+                if s0 is None:
+                    raise FileNotFoundError(
+                        f"targeted resume for batch {i} needs the shared "
+                        f"stage-0 artifacts in {store.parent_dir}"
+                    )
+                with torch.no_grad():
+                    delta0 = l2_project(
+                        _nchw(s0[0]), _nchw(s0[1]), x, cfg.attack.eps)
+                    target = model(x + delta0).argmax(-1).numpy()
+                target_list.append(target)
+        else:
+            y_attack = None
+            if cfg.attack.targeted:
+                target = _random_targets(rng, y_np, cfg.num_classes)
+                target_list.append(target)
+                y_attack = torch.from_numpy(target)
+            t_gen = time.time()
+            result = attack.generate(
+                x, y=y_attack, targeted=cfg.attack.targeted,
+                seed=cfg.seed + i, store=store, batch_id=i,
+            )
+            attack_seconds.append(time.time() - t_gen)
+            generated_images += int(x.shape[0])
+            adv_mask, adv_pattern = result.adv_mask, result.adv_pattern
+            store.save_patch(
+                i,
+                np.moveaxis(adv_mask.numpy(), 1, -1),
+                np.moveaxis(adv_pattern.numpy(), 1, -1),
+            )
+
+        with torch.no_grad():
+            delta = l2_project(adv_mask, adv_pattern, x, cfg.attack.eps)
+            adv_x = x + delta
+
+        recs = store.load_pc_records(i)
+        if recs is not None and any(len(r) != len(defenses) for r in recs):
+            recs = None
+        if recs is None:
+            per_defense = [
+                d.robust_predict(adv_x, cfg.num_classes) for d in defenses
+            ]
+            recs = [list(r) for r in zip(*per_defense)]
+            store.save_pc_records(i, recs)
+
+        preds_list.append(preds)
+        y_list.append(y_np)
+        with torch.no_grad():
+            preds_adv_list.append(model(adv_x).argmax(-1).numpy())
+        records.extend(recs)
+        if verbose:
+            print(f"batch {i}: {len(y_np)} imgs in {time.time() - t0:.1f}s",
+                  flush=True)
+
+    if not preds_list:
+        empty = {"clean_accuracy": 0.0, "robust_accuracy": 0.0,
+                 "acc_pc": [], "certified_acc_pc": [], "certified_asr_pc": [],
+                 "evaluated_images": 0,
+                 "report": "no correctly-classified images evaluated"}
+        if verbose:
+            print(empty["report"])
+        return empty
+    preds_clean = np.concatenate(preds_list)
+    y_all = np.concatenate(y_list)
+    preds_adv = np.concatenate(preds_adv_list)
+    targets = np.concatenate(target_list) if target_list else None
+
+    for di, d in enumerate(defenses):
+        d.collect([r[di] for r in records])
+    m = metrics.compute_metrics(
+        preds_clean, y_all, preds_adv, [d.result for d in defenses], targets)
+    m["evaluated_images"] = int(len(y_all))
+    if attack_seconds:
+        m["attack_seconds"] = attack_seconds
+        m["attack_images_per_sec"] = round(
+            generated_images / sum(attack_seconds), 4)
+    m["report"] = metrics.report_line(m)
+    if verbose:
+        print(m["report"])
+    return m
+
+
+def _random_targets(rng: np.random.Generator, y: np.ndarray, n_classes: int) -> np.ndarray:
+    """Random targets != label (same repair as the jax pipeline's
+    `_random_targets`: re-sample clashes instead of asserting)."""
+    t = rng.integers(0, n_classes, y.shape)
+    while (t == y).any():
+        clash = t == y
+        t[clash] = rng.integers(0, n_classes, clash.sum())
+    return t

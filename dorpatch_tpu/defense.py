@@ -153,6 +153,46 @@ def double_masking_verdict(
     return pred, certified
 
 
+def double_masking_verdict_np(
+    preds_1: np.ndarray,
+    preds_2: np.ndarray,
+    num_masks: int,
+    num_classes: int,
+):
+    """Pure-numpy twin of `double_masking_verdict` for the torch oracle
+    backend, which must not execute jax ops (in production environments any
+    jnp op initializes — and claims — the accelerator backend). Equivalence
+    with the jnp implementation is asserted by
+    `tests/test_torch_backend.py::test_verdict_np_matches_jnp` on random
+    tables, so the decision logic cannot drift silently."""
+    preds_1 = np.asarray(preds_1)
+    preds_2 = np.asarray(preds_2)
+    grid = _second_round_index_grid(num_masks)  # [M, M]
+    b = preds_1.shape[0]
+
+    counts = np.zeros((b, num_classes), np.int32)
+    np.add.at(counts, (np.arange(b)[:, None], preds_1), 1)
+    majority = counts.argmax(axis=-1).astype(preds_1.dtype)
+
+    unanimous = (preds_1 == preds_1[:, :1]).all(axis=1)
+    cert_consistent = (preds_2 == majority[:, None]).all(axis=1)
+    certified = unanimous & cert_consistent
+
+    second = preds_2[:, grid]  # [B, M, M]
+    eye = np.eye(num_masks, dtype=bool)[None]
+    second = np.where(eye, preds_1[:, :, None], second)
+
+    is_minority = preds_1 != majority[:, None]
+    row_unanimous = (second == preds_1[:, :, None]).all(axis=2)
+    recovers = is_minority & row_unanimous
+    any_recovery = recovers.any(axis=1)
+    idx = np.where(recovers, np.arange(num_masks)[None], -1).argmax(axis=1)
+    recovered_label = preds_1[np.arange(b), idx]
+    pred = np.where(unanimous, majority,
+                    np.where(any_recovery, recovered_label, majority))
+    return pred, certified
+
+
 @dataclasses.dataclass
 class PatchCleanser:
     """One certifier per mask family (reference `PatchCleanser`,

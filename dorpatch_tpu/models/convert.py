@@ -139,6 +139,39 @@ def convert_vit(sd: Mapping[str, np.ndarray], depth: int = 12, num_heads: int = 
     return {"params": params}
 
 
+def convert_cifar_resnet18(
+    sd: Mapping[str, np.ndarray], stage_sizes: Sequence[int] = (2, 2, 2, 2)
+) -> Dict:
+    """Convert a `backends.torch_models.CifarResNet18Torch` state_dict to the
+    flax `models.small.CifarResNet18` params (used by backend-parity tests to
+    run both backends with identical weights)."""
+
+    def gn(prefix):
+        return {"scale": _np(sd[prefix + ".weight"]), "bias": _np(sd[prefix + ".bias"])}
+
+    params: Dict = {
+        "stem": {"kernel": _conv_kernel(sd["stem.weight"])},
+        "stem_norm": gn("stem_norm"),
+        "head": _dense(sd, "head"),
+    }
+    bi_flat = 0
+    for si, depth in enumerate(stage_sizes):
+        for bi in range(depth):
+            src = f"blocks.{bi_flat}."
+            blk: Dict = {
+                "conv1": {"kernel": _conv_kernel(sd[src + "conv1.weight"])},
+                "norm1": gn(src + "norm1"),
+                "conv2": {"kernel": _conv_kernel(sd[src + "conv2.weight"])},
+                "norm2": gn(src + "norm2"),
+            }
+            if src + "proj.0.weight" in sd:
+                blk["proj"] = {"kernel": _conv_kernel(sd[src + "proj.0.weight"])}
+                blk["proj_norm"] = gn(src + "proj.1")
+            params[f"stage{si}_block{bi}"] = blk
+            bi_flat += 1
+    return {"params": params}
+
+
 def convert_resmlp(sd: Mapping[str, np.ndarray], depth: int = 24) -> Dict:
     """Convert a timm `resmlp_24_distilled_224` state_dict to flax ResMLP params."""
 

@@ -88,6 +88,10 @@ def _convert(timm_name: str, state_dict):
         from dorpatch_tpu.models.convert import convert_resmlp
 
         return convert_resmlp(state_dict)
+    if timm_name == "cifar_resnet18":
+        from dorpatch_tpu.models.convert import convert_cifar_resnet18
+
+        return convert_cifar_resnet18(state_dict)
     raise NotImplementedError(timm_name)
 
 

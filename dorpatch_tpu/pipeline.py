@@ -42,11 +42,9 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
     if cfg.backend not in ("jax-tpu", "torch"):
         raise ValueError(f"unknown backend {cfg.backend!r}")
     if cfg.backend == "torch":
-        raise NotImplementedError(
-            "the torch oracle backend covers models + bench steps "
-            "(dorpatch_tpu.backends); the full torch attack pipeline is the "
-            "reference implementation itself"
-        )
+        from dorpatch_tpu.backends.torch_pipeline import run_experiment_torch
+
+        return run_experiment_torch(cfg, verbose)
 
     utils.set_global_seed(cfg.seed)       # host RNGs (`utils.py:16-21`)
     utils.select_device(cfg.device)       # `--device` flag (`utils.py:12-13`)

@@ -125,5 +125,3 @@ def test_unknown_backend_rejected():
 
     with pytest.raises(ValueError):
         run_experiment(ExperimentConfig(backend="mlx"))
-    with pytest.raises(NotImplementedError):
-        run_experiment(ExperimentConfig(backend="torch"))
