@@ -61,6 +61,8 @@ class TrainState(NamedTuple):
     failed: jax.Array          # [n_mask] bool, current failure set
     coeff_gl: jax.Array        # f32 scalar, adaptive group-lasso coefficient
     coeff_struct: jax.Array    # f32 scalar, adaptive structural coefficient
+    coeff_density: jax.Array   # f32 scalar, density coefficient (traced so a
+                               # sweep varies it without recompiling the block)
     targeted: jax.Array        # [B] bool, per-image attack mode
     y: jax.Array               # [B] labels (ground truth or targets)
     last_preds: jax.Array      # [B,S] predictions of the last sampled forward
@@ -75,6 +77,24 @@ class AttackResult(NamedTuple):
     targeted: np.ndarray       # [B] bool, per-image mode after switching
     stage0_mask: jax.Array
     stage0_pattern: jax.Array
+
+
+# Config fields that never enter the compiled step/sweep graphs: they only
+# shape the eager stage transition (`patch_budget`, `num_patch`) or initialize
+# traced carry scalars (`density`/`structured`/`lr`, plus `targeted`). Two
+# configs equal outside this set compile to byte-identical programs, which is
+# what lets a hyperparameter sweep share one set of compiled blocks.
+BLOCK_IRRELEVANT_FIELDS = frozenset(
+    {"patch_budget", "num_patch", "density", "structured", "lr", "targeted"}
+)
+
+
+def block_signature(cfg: AttackConfig) -> tuple:
+    """Hashable fingerprint of every config field baked into the jitted
+    step/sweep programs (the complement of `BLOCK_IRRELEVANT_FIELDS`)."""
+    d = dataclasses.asdict(cfg)
+    return tuple(sorted(
+        (k, v) for k, v in d.items() if k not in BLOCK_IRRELEVANT_FIELDS))
 
 
 def patch_selection(
@@ -133,6 +153,10 @@ class DorPatch:
     on_block_end: Optional[Callable[[int, int, dict], None]] = None
     # optional CarryCheckpointer: mid-stage crash recovery (checkpoint.py)
     checkpointer: Optional[Any] = None
+    # optional (data, mask) jax.sharding.Mesh: keeps the fused Pallas
+    # mask-fill on multi-chip meshes via its shard_map wrapper
+    # (`ops.masked_fill`); see parallel.make_sharded_attack
+    mesh: Optional[Any] = None
 
     def __post_init__(self):
         cfg = self.config
@@ -161,8 +185,36 @@ class DorPatch:
             fwd = jax.checkpoint(fwd)
         self._fwd = fwd
         self._sampling_size = cfg.sampling_size
-        self._block_fns = {}
-        self._sweep_fn = None
+        # jitted program cache: (stage, img_size, n_steps) -> block fn, plus
+        # the "sweep" key. Shared *by reference* via `adopt_compiled`, so
+        # programs compiled through any sharing instance serve all of them.
+        self._programs = {}
+
+    def adopt_compiled(self, other: "DorPatch") -> None:
+        """Share `other`'s compiled step/sweep programs (zero recompiles).
+
+        Legal iff both attacks wrap the same victim (`apply_fn`/`params`
+        identity) with the same `remat` policy and agree on every config
+        field that is baked into the compiled graphs (`block_signature`).
+        Fields in `BLOCK_IRRELEVANT_FIELDS` may differ — they live in the
+        traced carry or the eager stage transition, which is exactly what a
+        hyperparameter sweep varies (`sweep.run_sweep`)."""
+        if self.apply_fn is not other.apply_fn or self.params is not other.params:
+            raise ValueError("adopt_compiled requires the identical victim "
+                             "(same apply_fn and params objects)")
+        if self.num_classes != other.num_classes:
+            raise ValueError("adopt_compiled: num_classes differs (baked into "
+                             "the compiled label-switch logic)")
+        if self.mesh is not other.mesh:
+            raise ValueError("adopt_compiled: mesh differs (baked into the "
+                             "compiled masked_fill dispatch)")
+        if self.remat != other.remat:
+            raise ValueError("adopt_compiled: remat policy differs")
+        if block_signature(self.config) != block_signature(other.config):
+            raise ValueError(
+                "adopt_compiled: configs differ in compiled-graph fields: "
+                f"{block_signature(self.config)} vs {block_signature(other.config)}")
+        self._programs = other._programs
 
     # ---------- mask sampling (static shapes) ----------
 
@@ -210,7 +262,8 @@ class DorPatch:
         adv_x = x + delta
         # fused rasterize+fill (Pallas on TPU): the [S,H,W] mask tensor is
         # never materialized; gradients flow to adv_x through the kept pixels
-        masked = ops.masked_fill(adv_x, rects, cfg.mask_fill, cfg.use_pallas)
+        masked = ops.masked_fill(adv_x, rects, cfg.mask_fill, cfg.use_pallas,
+                                 mesh=self.mesh)
         logits = self._fwd(self.params, masked.reshape((-1,) + x.shape[1:]))
         y_rep = jnp.repeat(state.y, s)
         targeted_rep = jnp.repeat(state.targeted, s)
@@ -220,14 +273,16 @@ class DorPatch:
 
         loss_struc = losses.structural_loss(adv_x, local_var_x)
         loss = jnp.mean(loss_adv, axis=1)
-        if cfg.structured != 0:
-            loss = loss + state.coeff_struct * loss_struc
+        # regularization coefficients are traced carry scalars (not Python
+        # constants baked into the graph), so a hyperparameter sweep varies
+        # them without recompiling the step block; a 0 coefficient is a
+        # mathematical no-op, same as the reference's `if != 0` guards
+        loss = loss + state.coeff_struct * loss_struc
         gl = jnp.zeros(b)
         dens = jnp.zeros(b)
         if stage == 0:
             dens = losses.density_loss(adv_mask, x.shape[1] // 8)
-            if cfg.density != 0:
-                loss = loss + cfg.density * dens
+            loss = loss + state.coeff_density * dens
             gl = losses.group_lasso(adv_mask, cfg.basic_unit)
             loss = loss + state.coeff_gl * gl
         preds = jnp.argmax(logits, axis=-1).reshape(b, s)
@@ -340,7 +395,8 @@ class DorPatch:
             step=state.step + 1, rng=rng, adv_mask=new_mask, adv_pattern=new_pattern,
             best_mask=best_mask, best_pattern=best_pattern, loss_best=loss_best,
             lr=lr, not_decay=not_decay, num_failure=num_failure, failed=failed,
-            coeff_gl=coeff_gl, coeff_struct=coeff_struct, targeted=state.targeted,
+            coeff_gl=coeff_gl, coeff_struct=coeff_struct,
+            coeff_density=state.coeff_density, targeted=state.targeted,
             y=state.y, last_preds=aux["preds"], stopped=state.stopped | stopped,
             metrics=metrics,
         )
@@ -353,7 +409,7 @@ class DorPatch:
 
     def _get_block(self, stage: int, img_size: int, n_steps: int):
         key = (stage, img_size, n_steps)
-        if key not in self._block_fns:
+        if key not in self._programs:
 
             @partial(jax.jit, static_argnums=())
             def run_block(state, x, local_var_x, universe):
@@ -363,13 +419,13 @@ class DorPatch:
                 state, _ = jax.lax.scan(body, state, None, length=n_steps)
                 return state
 
-            self._block_fns[key] = run_block
-        return self._block_fns[key]
+            self._programs[key] = run_block
+        return self._programs[key]
 
     def sweep_failures(self, adv_mask, adv_pattern, x, y, targeted, universe) -> jax.Array:
         """Full-universe failure sweep (`attack.py:384-406`): a mask index
         fails if any image's goal is violated under it. Returns bool [n_mask]."""
-        if self._sweep_fn is None:
+        if "sweep" not in self._programs:
 
             @jax.jit
             def sweep(adv_mask, adv_pattern, x, y, targeted, universe):
@@ -379,13 +435,14 @@ class DorPatch:
                     self._fwd, self.params, adv_x, universe,
                     min(self._sampling_size, universe.shape[0]),
                     self.config.mask_fill, self.config.use_pallas,
+                    mesh=self.mesh,
                 )  # [B, n_mask]
                 hit = preds == y[:, None]
                 fail_per_img = jnp.where(targeted[:, None], ~hit, hit)
                 return jnp.any(fail_per_img, axis=0)
 
-            self._sweep_fn = sweep
-        return self._sweep_fn(adv_mask, adv_pattern, x, y, targeted, universe)
+            self._programs["sweep"] = sweep
+        return self._programs["sweep"](adv_mask, adv_pattern, x, y, targeted, universe)
 
     # ---------- host orchestration ----------
 
@@ -407,6 +464,7 @@ class DorPatch:
             failed=jnp.zeros((universe_size,), bool),
             coeff_gl=jnp.asarray(cfg.coeff_group_lasso, jnp.float32),
             coeff_struct=jnp.asarray(cfg.structured, jnp.float32),
+            coeff_density=jnp.asarray(cfg.density, jnp.float32),
             targeted=jnp.broadcast_to(jnp.asarray(targeted, bool), (b,)).copy(),
             y=jnp.asarray(y, jnp.int32),
             last_preds=jnp.zeros((b, min(self._sampling_size, universe_size)), jnp.int32),

@@ -318,14 +318,25 @@ class TorchDorPatch:
     def _step(self, state: _State, adv_mask, adv_pattern, x, local_var_x,
               universe: np.ndarray, stage: int, rng: np.random.Generator,
               idx: Optional[np.ndarray] = None,
-              from_fail: Optional[np.ndarray] = None):
+              from_fail: Optional[np.ndarray] = None,
+              idx2: Optional[np.ndarray] = None):
         """One optimization step; returns updated (adv_mask, adv_pattern).
-        `idx`/`from_fail` may be injected (tests drive both backends with the
-        same EOT sample). Bookkeeping order matches `attack.DorPatch._step`."""
+        `idx`/`from_fail`/`idx2` may be injected (tests drive both backends
+        with the same EOT sample). Bookkeeping order matches
+        `attack.DorPatch._step`."""
         cfg = self.config
         if idx is None:
             idx, from_fail = self._sample_indices(rng, state.failed, state.step)
-        keep = rects_to_masks(universe[idx], x.shape[-1])
+        rects = universe[idx]
+        if cfg.dual:
+            # second independent occlusion layer (`/root/reference/
+            # attack.py:208-218`), mirroring the jax twin: the union of both
+            # rectangle sets as extra rows on the K axis; failure-set surgery
+            # stays keyed on the first draw only
+            if idx2 is None:
+                idx2, _ = self._sample_indices(rng, state.failed, state.step)
+            rects = np.concatenate([rects, universe[idx2]], axis=1)
+        keep = rects_to_masks(rects, x.shape[-1])
 
         adv_mask = adv_mask.detach().requires_grad_(stage == 0)
         adv_pattern = adv_pattern.detach().requires_grad_(True)

@@ -41,12 +41,24 @@ class CarryCheckpointer:
     keeps the newest `max_to_keep` snapshots; `restore` returns the latest
     or None. `clear` removes all snapshots (call after a successful
     generate so stale carries never leak into the next run).
+
+    `fingerprint` (any JSON-serializable dict — seed, batch id, attack
+    config hash, ...) is stored in the snapshot meta. On construction,
+    snapshots whose stored fingerprint differs from this instance's are
+    *deleted* (with a warning): a stale run's carry can never be restored by
+    this run, and orbax silently refuses saves at steps below the latest
+    existing one — a leftover stage-1 snapshot would otherwise both shadow
+    restores and block every new save. A re-run with e.g. a different seed
+    therefore regenerates instead of silently restoring a carry trained on
+    different images/targets.
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 2):
+    def __init__(self, directory: str, max_to_keep: int = 2,
+                 fingerprint: Optional[dict] = None):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
+        self.fingerprint = fingerprint
         self.directory = os.path.abspath(directory)
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -55,6 +67,20 @@ class CarryCheckpointer:
                 enable_async_checkpointing=False,  # blocks are seconds apart
             ),
         )
+        if fingerprint is not None:
+            for step in list(self._mgr.all_steps()):
+                meta = self._mgr.restore(
+                    step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+                )["meta"]
+                if meta.get("fingerprint") != fingerprint:
+                    import warnings
+
+                    warnings.warn(
+                        f"carry snapshot {step} in {self.directory} has "
+                        f"fingerprint {meta.get('fingerprint')!r} != this "
+                        f"run's {fingerprint!r}; deleting it (it could "
+                        "shadow restores and block saves)")
+                    self._mgr.delete(step)
 
     def save(self, stage: int, iteration: int, state: Any,
              stage0_mask=None, stage0_pattern=None) -> None:
@@ -63,24 +89,44 @@ class CarryCheckpointer:
         payload = {"state": state}
         if stage0_mask is not None:
             payload["stage0"] = {"mask": stage0_mask, "pattern": stage0_pattern}
+        meta = {"stage": int(stage), "iteration": step}
+        if self.fingerprint is not None:
+            meta["fingerprint"] = self.fingerprint
         self._mgr.save(
             stage * 10_000_000 + step,
             args=ocp.args.Composite(
                 carry=ocp.args.StandardSave(payload),
-                meta=ocp.args.JsonSave({"stage": int(stage), "iteration": step}),
+                meta=ocp.args.JsonSave(meta),
             ),
         )
 
     def restore(self, state_template: Any, stage0_template=None
                 ) -> Optional[CarryCheckpoint]:
-        """Latest snapshot, arrays placed like the (concrete) templates."""
+        """Newest snapshot whose fingerprint matches this run's, arrays
+        placed like the (concrete) templates.
+
+        Mismatching snapshots are skipped (with a warning), not merely
+        rejected at the latest step: a stale run's high-step snapshot in the
+        same directory must not shadow this run's own valid ones."""
         ocp = self._ocp
-        latest = self._mgr.latest_step()
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        meta = latest = None
+        for step in steps:
+            m = self._mgr.restore(
+                step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+            )["meta"]
+            if self.fingerprint is not None and m.get("fingerprint") != self.fingerprint:
+                import warnings
+
+                warnings.warn(
+                    f"carry snapshot {step} in {self.directory} has "
+                    f"fingerprint {m.get('fingerprint')!r} != this run's "
+                    f"{self.fingerprint!r}; skipping it")
+                continue
+            meta, latest = m, step
+            break
         if latest is None:
             return None
-        meta = self._mgr.restore(
-            latest, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
-        )["meta"]
         payload_t = {"state": state_template}
         if meta["stage"] == 1:
             if stage0_template is None:

@@ -65,13 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
-    if args.use_pallas == "on" and args.mesh_data * args.mesh_mask > 1:
-        raise SystemExit(
-            "--use-pallas on is single-device only: the Mosaic kernel is "
-            "opaque to GSPMD and would replicate the EOT tensor per chip. "
-            "Use --use-pallas auto (resolves to the partitionable XLA path "
-            "on a mesh) or drop the mesh flags."
-        )
+    # NOTE: "on"/"interpret" are legal under a mesh: the Pallas kernel runs
+    # per-shard via shard_map (ops.masked_fill._sharded_masked_fill_fn), so
+    # GSPMD opacity is no longer a concern; shapes the mesh does not divide
+    # fall back to the partitionable XLA path automatically.
     attack = AttackConfig(
         patch_budget=args.patch_budget,
         targeted=args.targeted,

@@ -67,6 +67,7 @@ def masked_predictions(
     chunk_size: int,
     fill: float = 0.5,
     use_pallas: str = "auto",
+    mesh=None,
 ) -> jax.Array:
     """Predictions under every mask in `rects`: `[B,H,W,C] x [N,K,4] -> [B,N]`.
 
@@ -86,7 +87,7 @@ def masked_predictions(
     batch = imgs.shape[0]
 
     def body(carry, chunk_rects):
-        xm = ops.masked_fill(imgs, chunk_rects, fill, use_pallas)
+        xm = ops.masked_fill(imgs, chunk_rects, fill, use_pallas, mesh=mesh)
         logits = apply_fn(params, xm.reshape((-1,) + imgs.shape[1:]))
         return carry, jnp.argmax(logits, axis=-1).reshape(batch, chunk_size)
 
@@ -203,6 +204,9 @@ class PatchCleanser:
     spec: masks_lib.MaskSpec
     config: DefenseConfig = dataclasses.field(default_factory=DefenseConfig)
     result: Any = None
+    # optional (data, mask) mesh: keeps the fused Pallas mask-fill sharded
+    # on multi-chip meshes (see ops.masked_fill)
+    mesh: Any = None
 
     def __post_init__(self):
         singles, doubles = masks_lib.mask_sets(self.spec)
@@ -218,7 +222,7 @@ class PatchCleanser:
             preds = masked_predictions(
                 self.apply_fn, params, imgs, self._rects,
                 self.config.chunk_size, self.config.mask_fill,
-                self.config.use_pallas,
+                self.config.use_pallas, mesh=self.mesh,
             )
             p1 = preds[:, : self._num_singles]
             p2 = preds[:, self._num_singles:]
@@ -249,7 +253,7 @@ class PatchCleanser:
 
 
 def build_defenses(
-    apply_fn, img_size: int, config: DefenseConfig = DefenseConfig()
+    apply_fn, img_size: int, config: DefenseConfig = DefenseConfig(), mesh=None
 ) -> List[PatchCleanser]:
     """The reference driver's 4-radius defense bank (`/root/reference/main.py:61`)."""
     return [
@@ -257,6 +261,7 @@ def build_defenses(
             apply_fn,
             masks_lib.geometry(img_size, r, config.n_patch, config.num_mask_per_axis),
             config,
+            mesh=mesh,
         )
         for r in config.ratios
     ]

@@ -56,14 +56,22 @@ class StdConv(nn.Module):
 
 
 class GroupNormRelu(nn.Module):
-    """GroupNorm(32, eps=1e-5) + ReLU (timm GroupNormAct)."""
+    """GroupNorm(32, eps=1e-5) + ReLU (timm GroupNormAct).
+
+    Statistics are computed in float32 for numerical parity with the torch
+    reference, but the output is cast back to the input dtype: under the
+    attack's bfloat16 mixed precision the surrounding convs must see bf16
+    activations, or every conv after the first GN silently runs on f32
+    activations at 2x the HBM traffic (measured ~26 TFLOP/s vs ~60+ fixed).
+    """
 
     num_groups: int = 32
 
     @nn.compact
     def __call__(self, x):
+        dt = x.dtype
         x = nn.GroupNorm(num_groups=self.num_groups, epsilon=1e-5, dtype=jnp.float32)(x)
-        return nn.relu(x)
+        return nn.relu(x).astype(dt)
 
 
 class PreActBottleneck(nn.Module):

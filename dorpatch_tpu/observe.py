@@ -32,7 +32,9 @@ METRIC_NAMES = (
     "loss_struc",   # structural TV ratio, mean
     "group_lasso",  # stage-0 group-lasso, mean
     "density",      # stage-0 density variance, mean
-    "masked_acc",   # victim accuracy on masked EOT batch (1.0 = attack losing)
+    "masked_acc",   # fraction of masked EOT samples predicted as state.y.
+                    # Untargeted (y = true label): 1.0 = attack losing.
+                    # After the targeted switch (y = target): 1.0 = winning.
     "l2",           # ||delta||_2 batch mean
     "n_failed",     # failure-set size (masks the attack currently loses to)
 )
@@ -122,15 +124,26 @@ class StepTimer:
         self.block_seconds.append(dt)
         return dt
 
-    def summary(self, steps_per_block: int, batch: int) -> dict:
+    def summary(self, steps_per_block: int, batch: int,
+                flops_per_step: float = 0.0,
+                peak_flops: float = 0.0) -> dict:
+        """Throughput summary; pass `flops_per_step` (useful FLOPs of one
+        optimization step — e.g. 3 x forward-FLOPs x EOT x batch from
+        `jit(fwd).lower(...).compile().cost_analysis()["flops"]`) and the
+        chip's `peak_flops` to get a defensible `mfu` row (SURVEY.md §6)."""
         total = float(sum(self.block_seconds))
         n_steps = steps_per_block * len(self.block_seconds)
-        return {
+        out = {
             "blocks": len(self.block_seconds),
             "total_seconds": round(total, 3),
             "steps_per_sec": round(n_steps / total, 3) if total else 0.0,
             "images_per_sec": round(n_steps * batch / total, 3) if total else 0.0,
         }
+        if flops_per_step and peak_flops and total:
+            out["achieved_tflops"] = round(
+                n_steps * flops_per_step / total / 1e12, 2)
+            out["mfu"] = round(n_steps * flops_per_step / total / peak_flops, 4)
+        return out
 
 
 @contextlib.contextmanager

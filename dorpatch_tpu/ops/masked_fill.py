@@ -145,16 +145,68 @@ _masked_fill_pallas.defvjp(_vjp_fwd, _vjp_bwd)
 
 
 def _auto_use_pallas() -> bool:
-    """Pallas iff single-device TPU. On a multi-chip mesh a `pallas_call`
-    is a Mosaic custom call that GSPMD cannot partition — it would stop the
-    mask-axis sharding propagation at the kernel boundary and replicate the
-    step's largest tensor per chip. The sharded path keeps the pure-XLA
-    rasterize+apply (which GSPMD splits along with the forward) until the
-    kernel grows a shard_map wrapper."""
+    """Pallas iff the backend is a TPU (the Mosaic kernel does not lower on
+    CPU outside interpreter mode)."""
     try:
-        return jax.default_backend() in ("tpu", "axon") and jax.device_count() == 1
+        return jax.default_backend() in ("tpu", "axon")
     except Exception:
         return False
+
+
+# --------------------------------------------------------- shard_map wrapper
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_masked_fill_fn(fill: float, interpret: bool, mesh,
+                            data_axis: str, mask_axis: str):
+    """A mesh-partitioned `masked_fill`: the Pallas kernel runs per shard
+    under `shard_map`, so a multi-chip mesh keeps the fused op instead of
+    falling back to the XLA rasterize path.
+
+    A raw `pallas_call` is a Mosaic custom call that GSPMD cannot partition —
+    it would stop mask-axis sharding propagation and replicate the step's
+    largest tensor per chip. `shard_map` sidesteps GSPMD entirely: images
+    shard over the data axis (replicated over mask), rectangle sets shard
+    over the mask axis, each device rasterizes+fills only its `[B/d, S/m]`
+    block, and the output carries the `(data, mask)` sharding the EOT
+    forward wants. The backward kernel accumulates per-shard image cotangents
+    and `psum`s them over the mask axis — the one collective this op needs.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    im_spec = P(data_axis)             # [B,H,W,C]: data-sharded, mask-replicated
+    rc_spec = P(mask_axis)             # [S,K,4]: mask-sharded
+    out_spec = P(data_axis, mask_axis)  # [B,S,H,W,C]
+
+    fwd_sm = shard_map(
+        lambda im, rc: _pallas_fwd(im, rc, fill, interpret),
+        mesh=mesh, in_specs=(im_spec, rc_spec), out_specs=out_spec,
+        check_vma=False,
+    )
+    bwd_sm = shard_map(
+        lambda rc, g: jax.lax.psum(_pallas_bwd(rc, g, interpret), mask_axis),
+        mesh=mesh, in_specs=(rc_spec, out_spec), out_specs=im_spec,
+        check_vma=False,
+    )
+
+    @jax.custom_vjp
+    def f(imgs, rects):
+        return fwd_sm(imgs, rects)
+
+    def f_fwd(imgs, rects):
+        return fwd_sm(imgs, rects), rects
+
+    def f_bwd(rects, g):
+        return bwd_sm(rects, g), None
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def _mesh_divides(imgs, rects, mesh, data_axis: str, mask_axis: str) -> bool:
+    return (imgs.shape[0] % mesh.shape[data_axis] == 0
+            and rects.shape[0] % mesh.shape[mask_axis] == 0)
 
 
 def masked_fill(
@@ -162,6 +214,9 @@ def masked_fill(
     rects: jax.Array,
     fill: float = 0.5,
     use_pallas: str = "auto",
+    mesh=None,
+    data_axis: str = "data",
+    mask_axis: str = "mask",
 ) -> jax.Array:
     """Occlude `imgs` with every rectangle set in `rects`, filling with `fill`.
 
@@ -169,14 +224,30 @@ def masked_fill(
     (half-open; zero-area rows are no-ops, matching `masks.pad_rects`).
     Returns `[B,S,H,W,C]`. Differentiable w.r.t. `imgs`.
 
-    use_pallas: "auto" (Pallas iff single-device TPU — see `_auto_use_pallas`
-    for why multi-chip meshes stay pure-XLA), "on", "off",
+    use_pallas: "auto" (Pallas on TPU backends, XLA elsewhere), "on", "off",
     "interpret" (Pallas in interpreter mode — for CPU tests).
+
+    mesh: a `jax.sharding.Mesh` with `(data_axis, mask_axis)` axes. On a
+    multi-device mesh the Pallas path runs under `shard_map`
+    (`_sharded_masked_fill_fn`); shapes the mesh does not divide fall back
+    to the partitionable XLA path.
     """
+    on_mesh = mesh is not None and mesh.devices.size > 1
     if use_pallas == "auto":
-        use_pallas = "on" if _auto_use_pallas() else "off"
+        # Pallas on TPU; on a multi-device platform only when the caller
+        # provided the mesh (the shard_map path) — a raw pallas_call under
+        # GSPMD would block sharding propagation and replicate the output.
+        single = jax.device_count() == 1
+        use_pallas = "on" if _auto_use_pallas() and (on_mesh or single) else "off"
+    if use_pallas not in ("on", "off", "interpret"):
+        raise ValueError(f"use_pallas={use_pallas!r}")
+    if use_pallas != "off" and on_mesh and not _mesh_divides(
+            imgs, rects, mesh, data_axis, mask_axis):
+        use_pallas = "off"
     if use_pallas == "off":
         return masked_fill_reference(imgs, rects, fill)
-    if use_pallas not in ("on", "interpret"):
-        raise ValueError(f"use_pallas={use_pallas!r}")
+    if on_mesh:
+        f = _sharded_masked_fill_fn(
+            float(fill), use_pallas == "interpret", mesh, data_axis, mask_axis)
+        return f(imgs, rects)
     return _masked_fill_pallas(imgs, rects, float(fill), use_pallas == "interpret")

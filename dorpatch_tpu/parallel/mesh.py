@@ -140,7 +140,8 @@ def place_replicated(mesh: Mesh, tree):
 
 def place_batch(mesh: Mesh, x: jax.Array, *per_image):
     """Place an image batch (and aligned per-image arrays) sharded over the
-    data axis. The data-axis size must divide the batch."""
+    data axis. The data-axis size must divide the batch. Single-process form;
+    multi-host jobs feed per-process shards via `place_batch_multihost`."""
     n_data = mesh.shape[DATA_AXIS]
     if x.shape[0] % n_data:
         raise ValueError(
@@ -153,3 +154,30 @@ def place_batch(mesh: Mesh, x: jax.Array, *per_image):
                 f"got shape {np.shape(a)}")
         out.append(jax.device_put(a, data_sharding(mesh, np.ndim(a))))
     return out[0] if not per_image else tuple(out)
+
+
+def place_batch_multihost(mesh: Mesh, x_local, *per_image_local):
+    """Multi-host feeding (BASELINE config 5, the v4-32 row): every process
+    passes only ITS shard of the global batch; the result is a global
+    `jax.Array` sharded over the data axis, assembled with
+    `jax.make_array_from_process_local_data` — no host ever materializes the
+    full batch, the TPU-native replacement for the reference's single-process
+    `DataParallel` scatter (`/root/reference/main.py:53`).
+
+    The data axis must be laid out so each process's addressable devices hold
+    a contiguous slice (what `make_mesh` produces via
+    `create_hybrid_device_mesh`: data across DCN granules, mask inside the
+    slice). Local batches must be equal-sized across processes; the global
+    leading dim is `process_count * local_batch`. On a single process this
+    degenerates to `place_batch` semantics (same sharding, same values).
+    """
+    out = []
+    for pos, a in enumerate((x_local,) + per_image_local):
+        a = np.asarray(a)
+        if pos and a.shape[0] != np.shape(x_local)[0]:
+            raise ValueError(
+                f"per_image arg {pos - 1} must have leading dim "
+                f"{np.shape(x_local)[0]}, got shape {a.shape}")
+        out.append(jax.make_array_from_process_local_data(
+            data_sharding(mesh, a.ndim), a))
+    return out[0] if not per_image_local else tuple(out)

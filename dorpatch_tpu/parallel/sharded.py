@@ -48,6 +48,7 @@ def make_sharded_attack(
         params=place_replicated(mesh, params),
         num_classes=num_classes,
         config=config,
+        mesh=mesh,   # keeps the fused Pallas mask-fill via its shard_map wrapper
         **kwargs,
     )
 
@@ -61,7 +62,8 @@ def make_sharded_defenses(
     """The 4-radius defense bank with certification sweeps sharded over the
     mesh (chunk axis splits across chips; the per-chunk forward is the unit
     of scatter, as in the attack)."""
-    return build_defenses(shard_apply_fn(apply_fn, mesh), img_size, config)
+    return build_defenses(shard_apply_fn(apply_fn, mesh), img_size, config,
+                          mesh=mesh)
 
 
 __all__ = [

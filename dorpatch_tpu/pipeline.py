@@ -46,6 +46,18 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
 
         return run_experiment_torch(cfg, verbose)
 
+    if jax.process_count() > 1:
+        # This driver is single-controller: the correctness filter, target
+        # draws, and record aggregation all assume the whole batch is host-
+        # addressable. Multi-host jobs drive the attack API directly —
+        # per-process shards go through `parallel.place_batch_multihost`
+        # into `parallel.make_sharded_attack(...).generate` (BASELINE
+        # config 5); a multi-process experiment driver is deliberately out
+        # of scope rather than silently wrong.
+        raise NotImplementedError(
+            "run_experiment is single-process; on multi-host jobs feed "
+            "per-process shards via parallel.place_batch_multihost and call "
+            "the attack/defense APIs directly")
     utils.set_global_seed(cfg.seed)       # host RNGs (`utils.py:16-21`)
     utils.select_device(cfg.device)       # `--device` flag (`utils.py:12-13`)
     rng = np.random.default_rng(cfg.seed)
@@ -129,9 +141,8 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                     target_list.append(target)
             else:
                 if cfg.attack.targeted:
-                    target = _random_targets(rng, y_np, victim.num_classes)
-                    target_list.append(target)
-                    y_attack = jnp.asarray(target)
+                    y_attack = jnp.asarray(
+                        _random_targets(rng, y_np, victim.num_classes))
                 else:
                     y_attack = None
                 ck = None
@@ -139,7 +150,13 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                     from dorpatch_tpu.checkpoint import CarryCheckpointer
 
                     ck = CarryCheckpointer(
-                        os.path.join(store.result_dir, f"carry_{i}"))
+                        os.path.join(store.result_dir, f"carry_{i}"),
+                        fingerprint={
+                            "seed": int(cfg.seed),
+                            "batch": int(i),
+                            "n_images": int(x.shape[0]),
+                            "attack": repr(cfg.attack),
+                        })
                     attack.checkpointer = ck
                 timer.start()
                 try:
@@ -157,6 +174,12 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                         ck.close()  # on failure snapshots stay for resume
                 timer.stop()
                 generated_images += int(x.shape[0])
+                if cfg.attack.targeted:
+                    # record the target the attack actually optimized toward:
+                    # on a carry-checkpoint resume the restored state.y is the
+                    # snapshot's target, not this process's fresh rng draw —
+                    # recording the draw would silently corrupt certified-ASR
+                    target_list.append(np.asarray(result.y))
                 adv_mask, adv_pattern = result.adv_mask, result.adv_pattern
                 store.save_patch(i, np.asarray(adv_mask), np.asarray(adv_pattern))
 
@@ -205,6 +228,8 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
     m = metrics.compute_metrics(
         preds_clean, y_all, preds_adv, [d.result for d in defenses], targets)
     m["evaluated_images"] = int(len(y_all))
+    if targets is not None:
+        m["targets"] = [int(t) for t in targets]
     if timer.block_seconds:
         # per-generate wall clock (each "block" is one attack.generate call)
         m["attack_seconds"] = timer.block_seconds

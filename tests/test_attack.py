@@ -201,3 +201,82 @@ def test_generate_stage0_store_roundtrip(tmp_path):
     res2 = atk.generate(x, key=jax.random.PRNGKey(5), store=store, batch_id=0)
     np.testing.assert_array_equal(
         np.asarray(res1.stage0_mask), np.asarray(res2.stage0_mask))
+
+
+# ---------- compiled-program sharing (sweep de-recompile) ----------
+
+@pytest.mark.slow
+def test_adopt_compiled_matches_fresh_instance():
+    """A DorPatch that adopts another's compiled programs (differing only in
+    carry-initialized hyperparameters) must produce bit-identical results to
+    a fresh instance — the sweep's zero-recompile contract."""
+    def apply_fn(params, x):
+        s = x.mean(axis=(1, 2))
+        return jnp.stack([s[:, 0], s[:, 1], s[:, 2], s.sum(-1) / 3.0], -1) * 10
+
+    base = dict(sampling_size=4, max_iterations=6, sweep_interval=3,
+                switch_iteration=3, dropout=1, dropout_sizes=(0.06,),
+                basic_unit=4)
+    cfg_a = AttackConfig(patch_budget=0.15, density=0.0, structured=1e-3, **base)
+    cfg_b = AttackConfig(patch_budget=0.3, density=5e-3, structured=2e-3, **base)
+
+    proto = DorPatch(apply_fn, None, 4, cfg_a, remat=False)
+    x = jax.random.uniform(jax.random.PRNGKey(6), (1, 16, 16, 3)) * 0.4
+    proto.generate(x, key=jax.random.PRNGKey(7))
+    n_programs = len(proto._programs)
+    assert n_programs >= 2  # at least one block + the sweep
+
+    adopted = DorPatch(apply_fn, None, 4, cfg_b, remat=False)
+    adopted.adopt_compiled(proto)
+    res_adopted = adopted.generate(x, key=jax.random.PRNGKey(7))
+    # the b-config introduced no new programs: blocks/sweep all shared
+    assert adopted._programs is proto._programs
+    assert len(proto._programs) == n_programs
+
+    fresh = DorPatch(apply_fn, None, 4, cfg_b, remat=False)
+    res_fresh = fresh.generate(x, key=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(
+        np.asarray(res_adopted.adv_mask), np.asarray(res_fresh.adv_mask))
+    np.testing.assert_allclose(
+        np.asarray(res_adopted.adv_pattern), np.asarray(res_fresh.adv_pattern),
+        atol=0)
+
+
+def test_adopt_compiled_rejects_graph_relevant_mismatch():
+    def apply_fn(params, x):
+        s = x.mean(axis=(1, 2))
+        return jnp.stack([s[:, 0], s[:, 1], s[:, 2], s.sum(-1) / 3.0], -1) * 10
+
+    a = DorPatch(apply_fn, None, 4, AttackConfig(sampling_size=4), remat=False)
+    b = DorPatch(apply_fn, None, 4, AttackConfig(sampling_size=8), remat=False)
+    with pytest.raises(ValueError):
+        b.adopt_compiled(a)
+    # differing victim objects are rejected even with equal configs
+    c = DorPatch(lambda p, x: apply_fn(p, x), None, 4,
+                 AttackConfig(sampling_size=4), remat=False)
+    with pytest.raises(ValueError):
+        c.adopt_compiled(a)
+    # remat mismatch is a different compiled graph
+    d = DorPatch(apply_fn, None, 4, AttackConfig(sampling_size=4), remat=True)
+    with pytest.raises(ValueError):
+        d.adopt_compiled(a)
+
+
+# ---------- dual occlusion layer (jax) ----------
+
+@pytest.mark.slow
+def test_generate_dual_smoke():
+    """`dual=True` end-to-end: the second occlusion layer doubles the K axis
+    of the sampled rectangle sets and the attack still optimizes/clips."""
+    cfg = AttackConfig(
+        sampling_size=4, max_iterations=6, sweep_interval=3,
+        switch_iteration=3, dropout=1, dropout_sizes=(0.06,), basic_unit=4,
+        patch_budget=0.15, dual=True,
+    )
+    atk = _tiny_attack(cfg)
+    x = jax.random.uniform(jax.random.PRNGKey(21), (1, 16, 16, 3)) * 0.3
+    res = atk.generate(x, key=jax.random.PRNGKey(22))
+    assert res.adv_pattern.shape == (1, 16, 16, 3)
+    assert np.asarray(res.adv_pattern).min() >= 0.0
+    assert np.asarray(res.adv_pattern).max() <= 1.0
+    assert set(np.unique(np.asarray(res.adv_mask))) <= {0.0, 1.0}

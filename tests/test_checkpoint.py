@@ -104,3 +104,64 @@ def test_mid_stage_resume_matches_uninterrupted(tmp_path):
         np.asarray(res.adv_pattern), np.asarray(full.adv_pattern), atol=1e-6)
     np.testing.assert_array_equal(
         np.asarray(res.adv_mask), np.asarray(full.adv_mask))
+
+
+def test_fingerprint_mismatch_purged(tmp_path):
+    """A snapshot saved under one fingerprint (seed/config identity) must
+    never restore into a run with a different fingerprint — it silently
+    carries state trained on different images/targets (round-1 advisor
+    finding). Mismatches are purged at construction: orbax refuses saves at
+    steps below the latest existing one, so a stale high-step snapshot would
+    otherwise also block every new save."""
+    atk = _tiny_attack(_cfg())
+    x = jax.random.uniform(jax.random.PRNGKey(0), (1, 16, 16, 3))
+    state = atk._init_state(jax.random.PRNGKey(1), x, jnp.zeros((1,), jnp.int32),
+                            False, 10)
+    fp1 = {"seed": 1234, "batch": 0}
+    with CarryCheckpointer(str(tmp_path / "ck"), fingerprint=fp1) as ck:
+        ck.save(0, 3, state)
+
+    # same fingerprint: snapshot survives and restores
+    with CarryCheckpointer(str(tmp_path / "ck"), fingerprint=fp1) as ck1:
+        got = ck1.restore(state)
+        assert got is not None and got.iteration == 3
+
+    # different fingerprint: snapshot purged with a warning, restore is None
+    with pytest.warns(UserWarning, match="fingerprint"):
+        ck2 = CarryCheckpointer(str(tmp_path / "ck"),
+                                fingerprint={"seed": 99, "batch": 0})
+    with ck2:
+        assert ck2.restore(state) is None
+
+    # legacy snapshots (no fingerprint recorded) are also purged by a
+    # fingerprinted open: absence of provenance is not a match
+    with CarryCheckpointer(str(tmp_path / "ck2")) as ck4:
+        ck4.save(0, 2, state)
+    with pytest.warns(UserWarning, match="fingerprint"):
+        ck5 = CarryCheckpointer(str(tmp_path / "ck2"), fingerprint=fp1)
+    with ck5:
+        assert ck5.restore(state) is None
+
+
+def test_fingerprint_purge_unblocks_new_runs_saves(tmp_path):
+    """The regression behind the purge: a stale run's stage-1 snapshot
+    (step 10_000_003) would make orbax silently drop this run's stage-0
+    saves (monotonic step requirement) AND shadow its restores. After the
+    purge, the new run saves and restores its own snapshots normally."""
+    atk = _tiny_attack(_cfg())
+    x = jax.random.uniform(jax.random.PRNGKey(0), (1, 16, 16, 3))
+    state = atk._init_state(jax.random.PRNGKey(1), x, jnp.zeros((1,), jnp.int32),
+                            False, 10)
+    fp_a, fp_b = {"seed": 1}, {"seed": 2}
+    d = str(tmp_path / "ck")
+    with CarryCheckpointer(d, fingerprint=fp_a) as ck:
+        ck.save(1, 3, state, state.adv_mask, state.adv_pattern)  # step 10_000_003
+    with pytest.warns(UserWarning, match="deleting"):
+        ck_b = CarryCheckpointer(d, fingerprint=fp_b)
+    with ck_b:
+        ck_b.save(0, 2, state)                                   # step 2
+        assert ck_b._mgr.all_steps() == [2]
+    with CarryCheckpointer(d, fingerprint=fp_b) as ck:
+        got = ck.restore(state)
+        assert got is not None
+        assert (got.stage, got.iteration) == (0, 2)

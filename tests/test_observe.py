@@ -67,3 +67,21 @@ def test_metric_names_match_attack_vector_width():
     from dorpatch_tpu.attack import DorPatch  # noqa: F401 (import side-check)
 
     assert len(observe.METRIC_NAMES) == 8
+
+
+def test_step_timer_summary_reports_mfu():
+    """SURVEY §6 / VERDICT r2 ask #2: summary carries a defensible MFU row
+    when given useful FLOPs per step and the chip peak."""
+    times = iter([0.0, 2.0, 2.0, 4.0]).__next__
+    t = observe.StepTimer(clock=times)
+    t.start(); t.stop()
+    t.start(); t.stop()
+    # 2 blocks x 5 steps, 1e12 useful FLOPs/step, 4s total -> 2.5 TFLOP/s
+    s = t.summary(steps_per_block=5, batch=2, flops_per_step=1e12,
+                  peak_flops=10e12)
+    assert s["achieved_tflops"] == 2.5
+    assert s["mfu"] == 0.25
+    assert s["images_per_sec"] == 5.0
+    # without flops/peak the mfu keys are absent (no bogus utilization rows)
+    s2 = t.summary(steps_per_block=5, batch=2)
+    assert "mfu" not in s2 and "achieved_tflops" not in s2

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Separate per-call dispatch overhead from per-step device compute.
+
+Hypothesis from bench vs scan-profile discrepancy: calls with *fresh*
+arguments pay a large constant per-call cost through the remote tunnel
+(~20s), while repeat calls with identical args appear memoized. Threading
+the state between calls defeats memoization, so:
+
+  per_call(block_k) = overhead + k * step
+  -> step = (per_call(block_8) - per_call(block_1)) / 7
+  -> overhead = per_call(block_1) - step
+
+Also times a trivial threaded jit (x <- x - 1e-6) and a threaded fwd+bwd to
+see whether the overhead is attack-specific or universal.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu import losses
+from dorpatch_tpu import masks as masks_lib
+from dorpatch_tpu.attack import DorPatch
+from dorpatch_tpu.config import AttackConfig
+from dorpatch_tpu.models import get_model
+
+
+def main():
+    b, s, img = 8, 32, 224
+    print(f"devices: {jax.devices()}", flush=True)
+    victim = get_model("imagenet", "resnetv2", img_size=img)
+
+    key = jax.random.PRNGKey(0)
+
+    # 1. trivial threaded jit
+    xsmall = jax.random.uniform(key, (256, 256))
+    triv = jax.jit(lambda a: a - 1e-6)
+    xs = triv(xsmall)
+    jax.block_until_ready(xs)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        xs = triv(xs)
+    jax.block_until_ready(xs)
+    print(f"trivial threaded jit: {(time.perf_counter()-t0)/10*1e3:.1f} ms/call",
+          flush=True)
+
+    # 2. threaded fwd+bwd on the EOT batch
+    params16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+        victim.params)
+    xb = jax.random.uniform(key, (b * s, img, img, 3), jnp.bfloat16)
+
+    @jax.jit
+    def fb(x):
+        g = jax.grad(lambda xx: victim.apply(params16, xx).astype(
+            jnp.float32).mean())(x)
+        return jnp.clip(x - 0.01 * jnp.sign(g), 0, 1)
+
+    xb = fb(xb)
+    jax.block_until_ready(xb)
+    t0 = time.perf_counter()
+    n = 4
+    for _ in range(n):
+        xb = fb(xb)
+    jax.block_until_ready(xb)
+    print(f"threaded fwd+bwd ({b*s} imgs): {(time.perf_counter()-t0)/n*1e3:.0f} ms/call",
+          flush=True)
+
+    # 3. attack blocks of 1 and 8 steps, threaded
+    cfg = AttackConfig(sampling_size=s, compute_dtype="bfloat16")
+    attack = DorPatch(victim.apply, victim.params, victim.num_classes, cfg,
+                      remat=False)
+    universe = jnp.asarray(
+        masks_lib.dropout_universe(img, cfg.dropout, cfg.dropout_sizes))
+    x = jax.random.uniform(key, (b, img, img, 3))
+    y = jnp.zeros((b,), jnp.int32)
+    lv = jnp.mean(losses.local_variance(x)[0], axis=-1)
+    state = attack._init_state(key, x, y, False, universe.shape[0])
+
+    for k, reps in ((1, 4), (8, 2)):
+        block = attack._get_block(1, img, k)
+        t0 = time.perf_counter()
+        state = block(state, x, lv, universe)
+        jax.block_until_ready(state.adv_pattern)
+        print(f"block{k} compile+first: {time.perf_counter()-t0:.1f}s", flush=True)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            state = block(state, x, lv, universe)
+        jax.block_until_ready(state.adv_pattern)
+        per_call = (time.perf_counter() - t0) / reps
+        print(f"block{k} threaded: {per_call:.2f} s/call", flush=True)
+
+
+if __name__ == "__main__":
+    main()

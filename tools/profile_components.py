@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Attribute the single-chip attack-step time to its components.
+
+Times, as separate jitted programs on the real chip:
+  1. victim forward (bf16, EOT-sized batch)
+  2. victim forward+backward w.r.t. input
+  3. fused masked_fill (Pallas) fwd
+  4. masked_fill fwd+bwd
+  5. the full stage-1 attack step (1-step block)
+and prints implied TFLOP/s per component so the gap has an address.
+
+Usage: python tools/profile_components.py [--batch 8] [--eot 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu import losses
+from dorpatch_tpu import masks as masks_lib
+from dorpatch_tpu.attack import DorPatch
+from dorpatch_tpu.config import AttackConfig
+from dorpatch_tpu.models import get_model
+
+RN50_FWD_GFLOPS = 4.3  # ResNetV2-50 @224 fwd, approx
+
+
+def timed(name, fn, *args, reps=5, flops=None):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    tfs = f"  {flops / dt / 1e12:8.2f} TFLOP/s" if flops else ""
+    print(f"{name:32s} {dt * 1e3:9.1f} ms/call  (compile {compile_s:.1f}s){tfs}",
+          flush=True)
+    return dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--eot", type=int, default=32)
+    p.add_argument("--img", type=int, default=224)
+    p.add_argument("--reps", type=int, default=5)
+    args = p.parse_args()
+    b, s, img = args.batch, args.eot, args.img
+    n = b * s
+
+    print(f"devices: {jax.devices()}  batch={b} eot={s} img={img}", flush=True)
+    victim = get_model("imagenet", "resnetv2", img_size=img)
+    params16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+        victim.params)
+
+    key = jax.random.PRNGKey(0)
+    xb = jax.random.uniform(key, (n, img, img, 3), jnp.bfloat16)
+
+    fwd = jax.jit(lambda p_, x_: victim.apply(p_, x_))
+    timed("model fwd (bf16)", fwd, params16, xb, reps=args.reps,
+          flops=n * RN50_FWD_GFLOPS * 1e9)
+
+    def loss_fn(x_):
+        return victim.apply(params16, x_).astype(jnp.float32).mean()
+
+    fwdbwd = jax.jit(jax.grad(loss_fn))
+    timed("model fwd+bwd (bf16)", fwdbwd, xb, reps=args.reps,
+          flops=n * 3 * RN50_FWD_GFLOPS * 1e9)
+
+    def loss_fn_remat(x_):
+        f = jax.checkpoint(lambda xx: victim.apply(params16, xx).astype(jnp.float32))
+        return f(x_).mean()
+
+    fwdbwd_r = jax.jit(jax.grad(loss_fn_remat))
+    timed("model fwd+bwd remat", fwdbwd_r, xb, reps=args.reps,
+          flops=n * 4 * RN50_FWD_GFLOPS * 1e9)
+
+    # masked_fill
+    cfg = AttackConfig(sampling_size=s)
+    universe = jnp.asarray(masks_lib.dropout_universe(img, cfg.dropout, cfg.dropout_sizes))
+    rects = universe[:s]
+    x = jax.random.uniform(key, (b, img, img, 3), jnp.float32)
+    from dorpatch_tpu import ops
+
+    mf = jax.jit(lambda x_, r_: ops.masked_fill(x_, r_, 0.5, "on"))
+    bytes_mf = (b * img * img * 3 + b * s * img * img * 3) * 4
+    timed(f"masked_fill pallas fwd ({bytes_mf / 1e6:.0f} MB)", mf, x, rects,
+          reps=args.reps)
+
+    mfg = jax.jit(jax.grad(lambda x_, r_: ops.masked_fill(x_, r_, 0.5, "on").sum(),
+                           argnums=0))
+    timed("masked_fill pallas fwd+bwd", mfg, x, rects, reps=args.reps)
+
+    mfx = jax.jit(lambda x_, r_: ops.masked_fill(x_, r_, 0.5, "off"))
+    timed("masked_fill XLA fwd", mfx, x, rects, reps=args.reps)
+
+    # full attack step
+    cfg = AttackConfig(sampling_size=s, compute_dtype="bfloat16")
+    attack = DorPatch(victim.apply, victim.params, victim.num_classes, cfg)
+    y = jnp.zeros((b,), jnp.int32)
+    lv = jnp.mean(losses.local_variance(x)[0], axis=-1)
+    state = attack._init_state(key, x, y, False, universe.shape[0])
+    block1 = attack._get_block(1, img, 1)
+    step_flops = n * 4 * RN50_FWD_GFLOPS * 1e9  # remat: fwd + (fwd+bwd)
+    dt = timed("attack step (stage1, remat)", block1, state, x, lv, universe,
+               reps=args.reps, flops=step_flops)
+    print(f"\nattack images/sec (batch {b}): {b / dt:.2f}", flush=True)
+
+    attack_nr = DorPatch(victim.apply, victim.params, victim.num_classes, cfg,
+                         remat=False)
+    block_nr = attack_nr._get_block(1, img, 1)
+    timed("attack step (no remat)", block_nr, state, x, lv, universe,
+          reps=args.reps, flops=n * 3 * RN50_FWD_GFLOPS * 1e9)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Scan-threaded microbenchmarks: trustworthy per-iteration device timing.
+
+Single-call timings through the remote tunnel are unreliable (identical-arg
+calls appear memoized). Here every measured program is ONE jit containing a
+`lax.scan` of K dependent iterations, so the device must execute all K and
+per-iteration time = wall / K.
+
+  1. fwd-only scan:    x -> logits -> fold a scalar back into x
+  2. fwd+bwd scan:     signed-grad update of x through the victim
+  3. fwd+bwd + masked_fill scan: the attack step's data path
+  4. the real attack step block (stage 1)
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu import losses
+from dorpatch_tpu import masks as masks_lib
+from dorpatch_tpu.attack import DorPatch
+from dorpatch_tpu.config import AttackConfig
+from dorpatch_tpu.models import get_model
+
+RN50_FWD_GFLOPS = 4.3
+
+
+def timed_scan(name, fn, args, k, flops_per_iter=None, reps=2):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    per_iter = (time.perf_counter() - t0) / (reps * k)
+    tfs = (f"  {flops_per_iter / per_iter / 1e12:7.2f} TFLOP/s"
+           if flops_per_iter else "")
+    print(f"{name:38s} {per_iter * 1e3:9.1f} ms/iter  (compile {compile_s:.0f}s){tfs}",
+          flush=True)
+    return per_iter
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--eot", type=int, default=32)
+    p.add_argument("--img", type=int, default=224)
+    p.add_argument("--k", type=int, default=8)
+    p.add_argument("--only", default="", help="comma list: fwd,bwd,mf,step")
+    args = p.parse_args()
+    b, s, img, k = args.batch, args.eot, args.img, args.k
+    n = b * s
+    only = set(args.only.split(",")) if args.only else None
+
+    print(f"devices: {jax.devices()}  batch={b} eot={s} img={img} k={k}", flush=True)
+    victim = get_model("imagenet", "resnetv2", img_size=img)
+    params16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+        victim.params)
+
+    key = jax.random.PRNGKey(0)
+    xb = jax.random.uniform(key, (n, img, img, 3), jnp.bfloat16)
+
+    if only is None or "fwd" in only:
+        @jax.jit
+        def fwd_scan(x0):
+            def body(x, _):
+                logits = victim.apply(params16, x)
+                return x + logits.mean().astype(x.dtype) * 1e-9, None
+            return jax.lax.scan(body, x0, None, length=k)[0]
+
+        timed_scan("fwd-only scan", fwd_scan, (xb,), k,
+                   n * RN50_FWD_GFLOPS * 1e9)
+
+    if only is None or "bwd" in only:
+        @jax.jit
+        def fwdbwd_scan(x0):
+            def body(x, _):
+                g = jax.grad(
+                    lambda xx: victim.apply(params16, xx).astype(jnp.float32).mean()
+                )(x)
+                return jnp.clip(x - 0.01 * jnp.sign(g), 0, 1), None
+            return jax.lax.scan(body, x0, None, length=k)[0]
+
+        timed_scan("fwd+bwd scan", fwdbwd_scan, (xb,), k,
+                   n * 3 * RN50_FWD_GFLOPS * 1e9)
+
+    cfg = AttackConfig(sampling_size=s, compute_dtype="bfloat16")
+    universe = jnp.asarray(
+        masks_lib.dropout_universe(img, cfg.dropout, cfg.dropout_sizes))
+    x = jax.random.uniform(key, (b, img, img, 3), jnp.float32)
+
+    if only is None or "mf" in only:
+        from dorpatch_tpu import ops
+
+        @jax.jit
+        def mf_scan(x0):
+            def body(xc, i):
+                rects = jax.lax.dynamic_slice_in_dim(universe, 0, s, 0)
+                masked = ops.masked_fill(xc, rects, 0.5, "on")
+                flat = masked.reshape((-1,) + xc.shape[1:]).astype(jnp.bfloat16)
+                g = jax.grad(
+                    lambda xx: victim.apply(
+                        params16,
+                        ops.masked_fill(xx, rects, 0.5, "on")
+                        .reshape((-1,) + xx.shape[1:]).astype(jnp.bfloat16),
+                    ).astype(jnp.float32).mean()
+                )(xc)
+                del flat
+                return jnp.clip(xc - 0.01 * jnp.sign(g), 0, 1), None
+            return jax.lax.scan(body, x0, None, length=k)[0]
+
+        timed_scan("masked_fill+fwd+bwd scan (pallas)", mf_scan, (x,), k,
+                   n * 3 * RN50_FWD_GFLOPS * 1e9)
+
+    if only is None or "step" in only:
+        attack = DorPatch(victim.apply, victim.params, victim.num_classes, cfg)
+        y = jnp.zeros((b,), jnp.int32)
+        lv = jnp.mean(losses.local_variance(x)[0], axis=-1)
+        state = attack._init_state(key, x, y, False, universe.shape[0])
+        block = attack._get_block(1, img, k)
+        dt = timed_scan("attack step block (remat)", block,
+                        (state, x, lv, universe), k,
+                        n * 4 * RN50_FWD_GFLOPS * 1e9)
+        print(f"attack images/sec: {b / dt:.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
