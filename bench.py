@@ -199,6 +199,10 @@ def child_jax() -> None:
             "mfu": round(mfu, 4) if mfu is not None else None,
             "step_seconds": round(step_seconds, 4),
             "fwd_gflops_per_image": round(f_fwd / 1e9, 2) if f_fwd else None,
+            # per-masked-sample throughput: the EOT batch is `eot` fwd+bwd
+            # passes per image-iteration, the unit the torch baseline row
+            # (EOT=1) pays once — the apples-to-apples per-sample speedup
+            "masked_images_per_sec": round(batch * eot / step_seconds, 1),
         }
 
     while True:
@@ -310,7 +314,8 @@ def main() -> None:
     }
     if res.get("mfu") is not None:
         out["mfu"] = res["mfu"]
-    for k in ("remat", "step_seconds", "fwd_gflops_per_image", "batch"):
+    for k in ("remat", "step_seconds", "fwd_gflops_per_image", "batch",
+              "masked_images_per_sec"):
         if res.get(k) is not None:
             out[k] = res[k]
     if fallback is not None:

@@ -100,29 +100,38 @@ def run_experiment_torch(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             adv_mask = _nchw(cached[0])
             adv_pattern = _nchw(cached[1])
             if cfg.attack.targeted:
-                s0 = store.load_stage0(i)
-                if s0 is None:
-                    raise FileNotFoundError(
-                        f"targeted resume for batch {i} needs the shared "
-                        f"stage-0 artifacts in {store.parent_dir}"
-                    )
-                with torch.no_grad():
-                    delta0 = l2_project(
-                        _nchw(s0[0]), _nchw(s0[1]), x, cfg.attack.eps)
-                    target = model(x + delta0).argmax(-1).numpy()
-                target_list.append(target)
+                # recorded target first; reference re-derivation fallback
+                # (`main.py:108-118`) — same contract as the jax pipeline
+                target = store.load_targets(i)
+                if target is None:
+                    s0 = store.load_stage0(i)
+                    if s0 is None:
+                        raise FileNotFoundError(
+                            f"targeted resume for batch {i} needs the recorded "
+                            f"targets or the shared stage-0 artifacts in "
+                            f"{store.parent_dir}"
+                        )
+                    with torch.no_grad():
+                        delta0 = l2_project(
+                            _nchw(s0[0]), _nchw(s0[1]), x, cfg.attack.eps)
+                        target = model(x + delta0).argmax(-1).numpy()
+                target_list.append(np.asarray(target))
         else:
             y_attack = None
             if cfg.attack.targeted:
-                target = _random_targets(rng, y_np, cfg.num_classes)
-                target_list.append(target)
-                y_attack = torch.from_numpy(target)
+                y_attack = torch.from_numpy(
+                    _random_targets(rng, y_np, cfg.num_classes))
             t_gen = time.time()
             result = attack.generate(
                 x, y=y_attack, targeted=cfg.attack.targeted,
                 seed=cfg.seed + i, store=store, batch_id=i,
             )
             attack_seconds.append(time.time() - t_gen)
+            if cfg.attack.targeted:
+                # the target the attack actually optimized (result.y), kept
+                # consistent with what cached re-runs will score against
+                target_list.append(np.asarray(result.y))
+                store.save_targets(i, np.asarray(result.y))
             generated_images += int(x.shape[0])
             adv_mask, adv_pattern = result.adv_mask, result.adv_pattern
             store.save_patch(

@@ -10,6 +10,7 @@ The jax path is the product; per-batch flow:
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Dict, List
@@ -124,21 +125,26 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             if cached is not None:
                 adv_mask, adv_pattern = map(jnp.asarray, cached)
                 if cfg.attack.targeted:
-                    # recover the target by re-running the stage-0 patch
-                    # (`main.py:108-118`)
-                    s0 = store.load_stage0(i)
-                    if s0 is None:
-                        raise FileNotFoundError(
-                            f"targeted resume for batch {i} needs the shared "
-                            f"stage-0 artifacts in {store.parent_dir}; they were "
-                            "removed — delete the per-budget patch files too to "
-                            "regenerate"
-                        )
-                    delta0 = losses.l2_project(
-                        jnp.asarray(s0[0]), jnp.asarray(s0[1]), x, cfg.attack.eps)
-                    target = np.asarray(
-                        jnp.argmax(victim.apply(victim.params, x + delta0), -1))
-                    target_list.append(target)
+                    # prefer the recorded target (what the attack actually
+                    # optimized, `store.save_targets`); fall back to the
+                    # reference's re-derivation from the stage-0 patch
+                    # (`main.py:108-118`) for reference-produced artifacts
+                    target = store.load_targets(i)
+                    if target is None:
+                        s0 = store.load_stage0(i)
+                        if s0 is None:
+                            raise FileNotFoundError(
+                                f"targeted resume for batch {i} needs the "
+                                f"recorded targets or the shared stage-0 "
+                                f"artifacts in {store.parent_dir}; they were "
+                                "removed — delete the per-budget patch files "
+                                "too to regenerate"
+                            )
+                        delta0 = losses.l2_project(
+                            jnp.asarray(s0[0]), jnp.asarray(s0[1]), x, cfg.attack.eps)
+                        target = np.asarray(
+                            jnp.argmax(victim.apply(victim.params, x + delta0), -1))
+                    target_list.append(np.asarray(target))
             else:
                 if cfg.attack.targeted:
                     y_attack = jnp.asarray(
@@ -178,8 +184,10 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                     # record the target the attack actually optimized toward:
                     # on a carry-checkpoint resume the restored state.y is the
                     # snapshot's target, not this process's fresh rng draw —
-                    # recording the draw would silently corrupt certified-ASR
+                    # recording the draw would silently corrupt certified-ASR.
+                    # Persist it so cached re-runs score the same target.
                     target_list.append(np.asarray(result.y))
+                    store.save_targets(i, np.asarray(result.y))
                 adv_mask, adv_pattern = result.adv_mask, result.adv_pattern
                 store.save_patch(i, np.asarray(adv_mask), np.asarray(adv_pattern))
 
@@ -238,4 +246,9 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
     m["report"] = metrics.report_line(m)
     if verbose:
         print(m["report"])
+    try:
+        with open(os.path.join(store.result_dir, "summary.json"), "w") as fh:
+            json.dump(m, fh, indent=1, default=float)
+    except OSError:
+        pass  # read-only results dir: the return value still carries everything
     return m

@@ -125,3 +125,34 @@ def test_unknown_backend_rejected():
 
     with pytest.raises(ValueError):
         run_experiment(ExperimentConfig(backend="mlx"))
+
+
+@pytest.mark.slow
+def test_targeted_cached_rerun_scores_same_targets(tmp_path):
+    """Certified-ASR must be scored against the same targets whether the
+    patch was just generated or loaded from cache. With a tiny budget the
+    stage-0 patch rarely reaches the target, so the reference's re-derivation
+    (prediction under the stage-0 patch, `main.py:108-118`) would disagree —
+    the recorded-targets file keeps the two evaluations identical."""
+    from dorpatch_tpu.pipeline import run_experiment
+
+    cfg = ExperimentConfig(
+        dataset="cifar10",
+        base_arch="resnet18",
+        batch_size=2,
+        num_batches=1,
+        synthetic_data=True,
+        img_size=32,
+        results_root=str(tmp_path / "results"),
+        attack=AttackConfig(
+            targeted=True, sampling_size=4, max_iterations=4,
+            sweep_interval=2, switch_iteration=2, dropout=1, basic_unit=4,
+            patch_budget=0.15,
+        ),
+        defense=DefenseConfig(ratios=(0.06,), chunk_size=18),
+    )
+    m = run_experiment(cfg, verbose=False)
+    assert "targets" in m and len(m["targets"]) == m["evaluated_images"]
+    m2 = run_experiment(cfg, verbose=False)  # cached patches + records
+    assert m2["targets"] == m["targets"]
+    assert m2["report"] == m["report"]
