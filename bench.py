@@ -176,13 +176,16 @@ def child_jax() -> None:
         for i in range(warmup):
             t0 = time.perf_counter()
             state = block(state, x, local_var_x, universe)
-            jax.block_until_ready(state.adv_pattern)
+            jax.device_get(state.metrics)
             log(f"warmup call {i}: {time.perf_counter() - t0:.2f}s")
 
+        # the timed region ends with a genuine device->host transfer of a
+        # small output (not just block_until_ready, which this backend has
+        # been observed resolving early on warm executables — PERF.md traps)
         t0 = time.perf_counter()
         for _ in range(reps):
             state = block(state, x, local_var_x, universe)
-        jax.block_until_ready(state.adv_pattern)
+        jax.device_get(state.metrics)
         step_seconds = (time.perf_counter() - t0) / (block_steps * reps)
 
         # MFU: useful FLOPs (fwd+bwd = 3x fwd, remat recompute excluded) per

@@ -127,7 +127,28 @@ class ArtifactStore:
     def save_targets(self, batch_id: int, targets: np.ndarray) -> None:
         import torch
 
-        torch.save(torch.as_tensor(np.asarray(targets)), self._targets_path(batch_id))
+        # copy: the source may be a non-writable jax buffer (same reason as
+        # _to_torch_nchw), which torch.as_tensor would alias with a warning
+        torch.save(torch.from_numpy(np.array(targets, copy=True)),
+                   self._targets_path(batch_id))
+
+    def resolve_targets(self, batch_id: int, rederive) -> np.ndarray:
+        """Targets for a cached patch: the recorded file when present, else
+        the reference's re-derivation from the shared stage-0 artifacts
+        (`/root/reference/main.py:108-118`) via `rederive((mask, pattern))`
+        — the backend-specific model forward. Shared by both pipelines so
+        the resume contract cannot drift between them."""
+        t = self.load_targets(batch_id)
+        if t is not None:
+            return np.asarray(t)
+        s0 = self.load_stage0(batch_id)
+        if s0 is None:
+            raise FileNotFoundError(
+                f"targeted resume for batch {batch_id} needs the recorded "
+                f"targets or the shared stage-0 artifacts in "
+                f"{self.parent_dir}; they were removed — delete the "
+                "per-budget patch files too to regenerate")
+        return np.asarray(rederive(s0))
 
     # -- PatchCleanser record cache (`main.py:144-153`) --
 

@@ -149,7 +149,9 @@ class DorPatch:
     params: Any
     num_classes: int
     config: AttackConfig = dataclasses.field(default_factory=AttackConfig)
-    remat: bool = True
+    # None -> follow config.remat ("auto" remats only when the masked batch
+    # exceeds config.remat_threshold); True/False force it
+    remat: Optional[bool] = None
     on_block_end: Optional[Callable[[int, int, dict], None]] = None
     # optional CarryCheckpointer: mid-stage crash recovery (checkpoint.py)
     checkpointer: Optional[Any] = None
@@ -181,8 +183,8 @@ class DorPatch:
 
         elif cfg.compute_dtype != "float32":
             raise ValueError(f"compute_dtype={cfg.compute_dtype!r}")
-        if self.remat:
-            fwd = jax.checkpoint(fwd)
+        if cfg.remat not in ("auto", "on", "off"):
+            raise ValueError(f"remat={cfg.remat!r}")
         self._fwd = fwd
         self._sampling_size = cfg.sampling_size
         # jitted program cache: (stage, img_size, n_steps) -> block fn, plus
@@ -215,6 +217,24 @@ class DorPatch:
                 "adopt_compiled: configs differ in compiled-graph fields: "
                 f"{block_signature(self.config)} vs {block_signature(other.config)}")
         self._programs = other._programs
+
+    def _grad_fwd(self, n_masked: int):
+        """The forward used under `jax.grad`, with the remat policy applied.
+
+        Rematerialization re-runs the forward during the backward (~25% more
+        FLOPs) to avoid storing activations; it only pays when the masked
+        batch would not fit HBM. `remat=None` follows `config.remat`:
+        "on"/"off" force it, "auto" remats when `n_masked` (images x EOT
+        samples) exceeds `config.remat_threshold`. The failure sweeps and
+        certification never differentiate, so they always use the plain
+        forward."""
+        if self.remat is not None:
+            use = self.remat
+        else:
+            cfg = self.config
+            use = cfg.remat == "on" or (
+                cfg.remat == "auto" and n_masked > cfg.remat_threshold)
+        return jax.checkpoint(self._fwd) if use else self._fwd
 
     # ---------- mask sampling (static shapes) ----------
 
@@ -264,7 +284,8 @@ class DorPatch:
         # never materialized; gradients flow to adv_x through the kept pixels
         masked = ops.masked_fill(adv_x, rects, cfg.mask_fill, cfg.use_pallas,
                                  mesh=self.mesh)
-        logits = self._fwd(self.params, masked.reshape((-1,) + x.shape[1:]))
+        logits = self._grad_fwd(b * s)(
+            self.params, masked.reshape((-1,) + x.shape[1:]))
         y_rep = jnp.repeat(state.y, s)
         targeted_rep = jnp.repeat(state.targeted, s)
         loss_adv = losses.cw_margin_switchable(

@@ -100,22 +100,15 @@ def run_experiment_torch(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             adv_mask = _nchw(cached[0])
             adv_pattern = _nchw(cached[1])
             if cfg.attack.targeted:
-                # recorded target first; reference re-derivation fallback
-                # (`main.py:108-118`) — same contract as the jax pipeline
-                target = store.load_targets(i)
-                if target is None:
-                    s0 = store.load_stage0(i)
-                    if s0 is None:
-                        raise FileNotFoundError(
-                            f"targeted resume for batch {i} needs the recorded "
-                            f"targets or the shared stage-0 artifacts in "
-                            f"{store.parent_dir}"
-                        )
+                # recorded target first; reference re-derivation fallback —
+                # shared contract in ArtifactStore.resolve_targets
+                def _rederive(s0):
                     with torch.no_grad():
                         delta0 = l2_project(
                             _nchw(s0[0]), _nchw(s0[1]), x, cfg.attack.eps)
-                        target = model(x + delta0).argmax(-1).numpy()
-                target_list.append(np.asarray(target))
+                        return model(x + delta0).argmax(-1).numpy()
+
+                target_list.append(store.resolve_targets(i, _rederive))
         else:
             y_attack = None
             if cfg.attack.targeted:

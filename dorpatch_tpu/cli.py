@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="EOT forward+backward precision (carry stays float32)")
+    p.add_argument("--remat", default="auto", choices=["auto", "on", "off"],
+                   help="rematerialize the EOT forward in the backward "
+                        "(memory for ~25%% step time; auto: only when the "
+                        "masked batch exceeds the remat threshold)")
     return p
 
 
@@ -83,6 +87,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         num_patch=args.num_patch,
         use_pallas=args.use_pallas,
         compute_dtype=args.compute_dtype,
+        remat=args.remat,
     )
     return ExperimentConfig(
         dataset=args.dataset,

@@ -62,6 +62,14 @@ class AttackConfig:
     use_pallas: str = "auto"                   # fused mask-fill kernel: auto|on|off|interpret
     compute_dtype: str = "float32"             # EOT fwd+bwd precision: float32|bfloat16
                                                # (carry/losses stay float32 either way)
+    remat: str = "auto"                        # rematerialize the EOT forward in the
+                                               # backward: auto|on|off. "on" trades ~25%
+                                               # step time for activation memory; "auto"
+                                               # remats only when the masked batch
+                                               # (images x sampling_size) exceeds
+                                               # remat_threshold
+    remat_threshold: int = 256                 # masked-batch size where "auto" turns remat on
+                                               # (batch 8 x EOT 32 fits v5e HBM without it)
 
     @property
     def scale_down(self) -> float:

@@ -125,26 +125,17 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             if cached is not None:
                 adv_mask, adv_pattern = map(jnp.asarray, cached)
                 if cfg.attack.targeted:
-                    # prefer the recorded target (what the attack actually
-                    # optimized, `store.save_targets`); fall back to the
-                    # reference's re-derivation from the stage-0 patch
-                    # (`main.py:108-118`) for reference-produced artifacts
-                    target = store.load_targets(i)
-                    if target is None:
-                        s0 = store.load_stage0(i)
-                        if s0 is None:
-                            raise FileNotFoundError(
-                                f"targeted resume for batch {i} needs the "
-                                f"recorded targets or the shared stage-0 "
-                                f"artifacts in {store.parent_dir}; they were "
-                                "removed — delete the per-budget patch files "
-                                "too to regenerate"
-                            )
+                    # recorded target (what the attack actually optimized)
+                    # first; reference re-derivation fallback — shared
+                    # contract in ArtifactStore.resolve_targets
+                    def _rederive(s0):
                         delta0 = losses.l2_project(
-                            jnp.asarray(s0[0]), jnp.asarray(s0[1]), x, cfg.attack.eps)
-                        target = np.asarray(
-                            jnp.argmax(victim.apply(victim.params, x + delta0), -1))
-                    target_list.append(np.asarray(target))
+                            jnp.asarray(s0[0]), jnp.asarray(s0[1]), x,
+                            cfg.attack.eps)
+                        return jnp.argmax(
+                            victim.apply(victim.params, x + delta0), -1)
+
+                    target_list.append(store.resolve_targets(i, _rederive))
             else:
                 if cfg.attack.targeted:
                     y_attack = jnp.asarray(

@@ -280,3 +280,54 @@ def test_generate_dual_smoke():
     assert np.asarray(res.adv_pattern).min() >= 0.0
     assert np.asarray(res.adv_pattern).max() <= 1.0
     assert set(np.unique(np.asarray(res.adv_mask))) <= {0.0, 1.0}
+
+
+# ---------- remat policy ----------
+
+def test_remat_policy_resolution():
+    """`remat=None` follows config ("auto" keys off the masked-batch size);
+    True/False force; bad config strings are rejected."""
+    import dataclasses as dc
+
+    def apply_fn(params, x):
+        return x.mean(axis=(1, 2))
+
+    cfg = AttackConfig(remat="auto", remat_threshold=16)
+    atk = DorPatch(apply_fn, None, 3, cfg)           # remat=None -> config
+    assert atk._grad_fwd(16) is atk._fwd             # at threshold: plain
+    assert atk._grad_fwd(17) is not atk._fwd         # above: checkpointed
+
+    on = DorPatch(apply_fn, None, 3, dc.replace(cfg, remat="on"))
+    assert on._grad_fwd(1) is not on._fwd
+    off = DorPatch(apply_fn, None, 3, dc.replace(cfg, remat="off"))
+    assert off._grad_fwd(10**6) is off._fwd
+
+    forced = DorPatch(apply_fn, None, 3, cfg, remat=False)
+    assert forced._grad_fwd(10**6) is forced._fwd
+
+    with pytest.raises(ValueError):
+        DorPatch(apply_fn, None, 3, dc.replace(cfg, remat="maybe"))
+
+
+def test_remat_on_off_same_results():
+    """Remat changes scheduling, not math: one jitted step must produce the
+    same state either way."""
+    import dataclasses as dc
+    from dorpatch_tpu import masks as masks_lib
+
+    cfg = AttackConfig(sampling_size=4, dropout=1, dropout_sizes=(0.06,),
+                       basic_unit=4)
+    x = jax.random.uniform(jax.random.PRNGKey(0), (1, 16, 16, 3))
+    universe = jnp.asarray(masks_lib.dropout_universe(16, 1, (0.06,)))
+    lv = jnp.zeros((1, 16, 16))
+
+    outs = []
+    for mode in ("on", "off"):
+        atk = _tiny_attack(dc.replace(cfg, remat=mode))
+        atk = DorPatch(atk.apply_fn, None, 4, dc.replace(cfg, remat=mode))
+        state = atk._init_state(jax.random.PRNGKey(1), x,
+                                jnp.zeros((1,), jnp.int32), False,
+                                universe.shape[0])
+        outs.append(atk._get_block(1, 16, 2)(state, x, lv, universe))
+    np.testing.assert_allclose(np.asarray(outs[0].adv_pattern),
+                               np.asarray(outs[1].adv_pattern), atol=1e-6)
