@@ -68,8 +68,9 @@ class AttackConfig:
                                                # remats only when the masked batch
                                                # (images x sampling_size) exceeds
                                                # remat_threshold
-    remat_threshold: int = 256                 # masked-batch size where "auto" turns remat on
-                                               # (batch 8 x EOT 32 fits v5e HBM without it)
+    remat_threshold: int = 512                 # masked-batch size above which "auto" remats
+                                               # (512 masked images @224 RN50 bf16 measured
+                                               # to fit v5e HBM without remat — PERF.md)
 
     @property
     def scale_down(self) -> float:

@@ -1,0 +1,76 @@
+"""Child process for the 2-process CPU multi-host test (BASELINE config 5).
+
+Launched twice by `test_parallel.py::test_two_process_multihost_feeding`
+with `jax.distributed` over a localhost coordinator; each process owns 4
+virtual CPU devices of a global 8-device `(data=2, mask=4)` mesh and feeds
+ONLY its local shard of the batch through
+`parallel.place_batch_multihost` — the TPU-native analog of per-host data
+loading on a multi-host pod. Asserts:
+
+  1. the global array assembles with the right shape/sharding and values
+     (per-process constant shards -> distinguishable global sums);
+  2. one jitted sharded DorPatch attack block runs to completion over the
+     multi-process mesh and returns finite metrics on every process.
+
+Usage: multihost_child.py <process_id> <coordinator_port>
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dorpatch_tpu import losses, parallel  # noqa: E402
+from dorpatch_tpu import masks as masks_lib  # noqa: E402
+from dorpatch_tpu.config import AttackConfig  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+mesh = parallel.make_mesh(2, 4)
+
+# ---- 1. multihost feeding: per-process shards -> one global batch ----
+local = np.full((2, 8, 8, 3), float(pid), np.float32)
+local_y = np.full((2,), pid, np.int32)
+x, y = parallel.place_batch_multihost(mesh, local, local_y)
+assert x.shape == (4, 8, 8, 3), x.shape
+assert y.shape == (4,)
+sums = jax.jit(lambda a: a.sum(axis=(1, 2, 3)))(x)
+got = np.sort(np.asarray(multihost_utils.process_allgather(sums, tiled=True)))
+np.testing.assert_allclose(got, [0.0, 0.0, 192.0, 192.0])
+
+# ---- 2. a sharded attack block over the multi-process mesh ----
+
+
+def toy_apply(params, xx):
+    s = xx.mean(axis=(1, 2))
+    return jnp.stack([s[:, 0], s[:, 1], s[:, 2], s.sum(-1) / 3.0], -1) * 10
+
+
+cfg = AttackConfig(sampling_size=4, dropout=1, dropout_sizes=(0.06,),
+                   basic_unit=4, max_iterations=2, sweep_interval=2,
+                   switch_iteration=2)
+attack = parallel.make_sharded_attack(toy_apply, None, 4, cfg, mesh,
+                                      remat=False)
+universe = jnp.asarray(masks_lib.dropout_universe(8, 1, (0.06,)))
+lv = jnp.mean(losses.local_variance(x)[0], axis=-1)
+state = attack._init_state(jax.random.PRNGKey(0), x, y, False,
+                           universe.shape[0])
+state = attack._get_block(1, 8, 2)(state, x, lv, universe)
+metrics = np.asarray(state.metrics)  # replicated -> addressable everywhere
+assert np.isfinite(metrics).all(), metrics
+assert int(np.asarray(state.step)) == 2
+print(f"proc {pid}: OK (metrics[0]={metrics[0]:.4f})", flush=True)

@@ -73,7 +73,7 @@ def test_majority_incorrect_tie_takes_smallest():
 
 # ---------- sampling invariants ----------
 
-def _tiny_attack(cfg=None, num_classes=4):
+def _tiny_attack(cfg=None, num_classes=4, **kw):
     def apply_fn(params, x):
         # cheap "model": class scores from pooled pixel stats
         s = x.mean(axis=(1, 2))  # [B,3]
@@ -81,7 +81,8 @@ def _tiny_attack(cfg=None, num_classes=4):
             [s[:, 0], s[:, 1], s[:, 2], s.sum(-1) / 3.0], axis=-1)
         return logits * 10
     cfg = cfg or AttackConfig()
-    return DorPatch(apply_fn, None, num_classes, cfg, remat=False)
+    kw.setdefault("remat", False)  # pass remat=None to follow cfg.remat
+    return DorPatch(apply_fn, None, num_classes, cfg, **kw)
 
 
 def test_sample_indices_static_and_biased():
@@ -323,8 +324,7 @@ def test_remat_on_off_same_results():
 
     outs = []
     for mode in ("on", "off"):
-        atk = _tiny_attack(dc.replace(cfg, remat=mode))
-        atk = DorPatch(atk.apply_fn, None, 4, dc.replace(cfg, remat=mode))
+        atk = _tiny_attack(dc.replace(cfg, remat=mode), remat=None)
         state = atk._init_state(jax.random.PRNGKey(1), x,
                                 jnp.zeros((1,), jnp.int32), False,
                                 universe.shape[0])

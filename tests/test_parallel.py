@@ -3,6 +3,8 @@ scale-free distributed testing). The load-bearing property: sharding is a
 *placement* decision — sharded and unsharded runs compute the same program,
 so results must match to float tolerance."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -241,3 +243,42 @@ def test_place_batch_multihost_rejects_misaligned_per_image():
     x = np.zeros((4, 8, 8, 3), np.float32)
     with pytest.raises(ValueError):
         parallel.place_batch_multihost(mesh, x, np.zeros((3,), np.int32))
+
+
+@pytest.mark.slow
+def test_two_process_multihost_feeding():
+    """True 2-process multi-host run on CPU (VERDICT r2 ask #9): two
+    jax.distributed processes, 4 virtual devices each, assemble a global
+    batch from per-process shards via place_batch_multihost and run a
+    sharded attack block over the joint (2,4) mesh. See multihost_child.py
+    for the assertions."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+
+    child = os.path.join(os.path.dirname(__file__), "multihost_child.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PALLAS_AXON_POOL_IPS"] = ""  # no accelerator plugin in children
+    procs = [
+        subprocess.Popen([sys.executable, child, str(i), port], env=env,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
+        assert f"proc {i}: OK" in out
