@@ -371,6 +371,13 @@ def no_axon_env() -> dict:
 
 
 def main() -> None:
+    mode = os.environ.get("BENCH_MODE", "attack")
+    if mode not in ("attack", "certify"):
+        print(json.dumps({"metric": "patch-opt images/sec", "value": 0.0,
+                          "unit": "images/sec", "vs_baseline": 0.0,
+                          "error": f"unknown BENCH_MODE={mode!r} "
+                                   "(use 'attack' or 'certify')"}))
+        return
     eot = int(os.environ.get("BENCH_EOT", "32"))
     jax_timeout = int(os.environ.get("BENCH_JAX_TIMEOUT", "1200"))
     torch_timeout = int(os.environ.get("BENCH_TORCH_TIMEOUT", "600"))
@@ -400,7 +407,7 @@ def main() -> None:
     log(f"jax: {res['ips']:.3f} images/sec; torch baseline: {torch_ips}")
 
     model_tag = "RN50-BiT@224" if (arch, img) == ("resnetv2", 224) else f"{arch}@{img}"
-    if os.environ.get("BENCH_MODE") == "certify":
+    if mode == "certify":
         metric = (f"PatchCleanser certifications/sec "
                   f"({model_tag}, 666-mask radius 0.06, jit)")
     else:
