@@ -371,7 +371,8 @@ def no_axon_env() -> dict:
 
 
 def main() -> None:
-    mode = os.environ.get("BENCH_MODE", "attack")
+    # empty string = unset (the same convention as PALLAS_AXON_POOL_IPS)
+    mode = os.environ.get("BENCH_MODE") or "attack"
     if mode not in ("attack", "certify"):
         print(json.dumps({"metric": "patch-opt images/sec", "value": 0.0,
                           "unit": "images/sec", "vs_baseline": 0.0,

@@ -1,0 +1,68 @@
+"""Fast tests of bench.py's driver-facing behavior (no accelerator, no
+model builds): peak-FLOPs resolution and the BENCH_MODE guard. The heavy
+measurement paths are exercised on hardware (PERF.md) and by the CPU smoke
+invocations documented there."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+class _Dev:
+    def __init__(self, kind):
+        self.device_kind = kind
+
+
+def test_peak_tflops_known_chips(monkeypatch):
+    monkeypatch.delenv("BENCH_PEAK_TFLOPS", raising=False)
+    assert bench._peak_tflops([_Dev("TPU v5 lite")]) == 197.0
+    assert bench._peak_tflops([_Dev("TPU v5e")]) == 197.0
+    assert bench._peak_tflops([_Dev("TPU v5p")]) == 459.0
+    assert bench._peak_tflops([_Dev("TPU v4")]) == 275.0
+
+
+def test_peak_tflops_unknown_is_zero_no_bogus_mfu(monkeypatch):
+    """Unrecognized devices (e.g. the CPU fallback) must not get a made-up
+    peak — a 0.0 peak makes child_jax omit the MFU row entirely."""
+    monkeypatch.delenv("BENCH_PEAK_TFLOPS", raising=False)
+    assert bench._peak_tflops([_Dev("cpu")]) == 0.0
+
+
+def test_peak_tflops_env_override(monkeypatch):
+    monkeypatch.setenv("BENCH_PEAK_TFLOPS", "123.5")
+    assert bench._peak_tflops([_Dev("cpu")]) == 123.5
+
+
+def test_empty_bench_mode_means_attack_default(monkeypatch, capsys):
+    """BENCH_MODE= (empty) follows the codebase's empty-string-means-unset
+    convention: main() proceeds with the attack benchmark (here: children
+    stubbed out, so it reaches the could-not-run path) instead of emitting
+    the unknown-mode error."""
+    monkeypatch.setenv("BENCH_MODE", "")
+    monkeypatch.setattr(bench, "run_child", lambda *a, **k: None)
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["error"] == "benchmark could not run"  # not the mode error
+
+
+@pytest.mark.parametrize("mode", ["bogus", "CERTIFY", " attack"])
+def test_unknown_bench_mode_yields_error_json(mode):
+    """The orchestrator rejects unknown BENCH_MODE before spawning any
+    (expensive, device-claiming) children — main() returns the error line
+    immediately, so this subprocess finishes in milliseconds."""
+    env = dict(os.environ)
+    env["BENCH_MODE"] = mode
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(bench.__file__), "bench.py")],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "error" in rec and mode in rec["error"]
+    assert rec["value"] == 0.0

@@ -14,6 +14,11 @@ Usage: python tools/profile_components.py [--batch 8] [--eot 32]
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 
