@@ -37,7 +37,9 @@ per jitted block), BENCH_REPS (3 timed blocks), BENCH_WARMUP (3 untimed
 steady-state warm-up calls after compile — see the warm-up note in
 `child_jax`), BENCH_TORCH_ITERS (3), BENCH_ARCH / BENCH_DATASET / BENCH_IMG
 (model selection), BENCH_REMAT (0/1, default 0 = no remat, auto-falls-back
-to 1 on OOM), BENCH_PEAK_TFLOPS, BENCH_JAX_TIMEOUT (seconds, default 1200),
+to 1 on OOM), BENCH_GN (GroupNorm impl for ResNetV2 victims: "auto" =
+fused Pallas kernel on single-chip TPU, "flax" = XLA path — see
+ops/fused_gn.py), BENCH_PEAK_TFLOPS, BENCH_JAX_TIMEOUT (seconds, default 1200),
 BENCH_TORCH_TIMEOUT (default 600).
 """
 
@@ -185,7 +187,8 @@ def child_jax() -> None:
             return 0.0
 
     def run(batch: int, remat: bool) -> dict:
-        victim = get_model(dataset, arch, img_size=img)
+        victim = get_model(dataset, arch, img_size=img,
+                           gn_impl=os.environ.get("BENCH_GN") or "auto")
         cfg = AttackConfig(sampling_size=eot, compute_dtype=dtype)
         attack = DorPatch(victim.apply, victim.params, victim.num_classes, cfg,
                           remat=remat)
@@ -274,7 +277,8 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
     from dorpatch_tpu.defense import build_defenses
     from dorpatch_tpu.models import get_model
 
-    victim = get_model(dataset, arch, img_size=img)
+    victim = get_model(dataset, arch, img_size=img,
+                       gn_impl=os.environ.get("BENCH_GN") or "auto")
     apply_fn = victim.apply
     if dtype == "bfloat16":
         params16 = jax.tree_util.tree_map(
@@ -378,6 +382,13 @@ def main() -> None:
                           "unit": "images/sec", "vs_baseline": 0.0,
                           "error": f"unknown BENCH_MODE={mode!r} "
                                    "(use 'attack' or 'certify')"}))
+        return
+    gn = os.environ.get("BENCH_GN") or "auto"
+    if gn not in ("auto", "flax", "pallas", "interpret", "jnp"):
+        print(json.dumps({"metric": "patch-opt images/sec", "value": 0.0,
+                          "unit": "images/sec", "vs_baseline": 0.0,
+                          "error": f"unknown BENCH_GN={gn!r} (use 'auto', "
+                                   "'flax', 'pallas', 'interpret' or 'jnp')"}))
         return
     eot = int(os.environ.get("BENCH_EOT", "32"))
     jax_timeout = int(os.environ.get("BENCH_JAX_TIMEOUT", "1200"))

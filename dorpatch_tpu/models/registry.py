@@ -55,11 +55,11 @@ def checkpoint_path(model_dir: str, dataset: str, timm_name: str) -> str:
     return os.path.join(model_dir, dataset, f"{timm_name}_cutout2_128_{dataset}.pth")
 
 
-def _build_flax(timm_name: str, num_classes: int):
+def _build_flax(timm_name: str, num_classes: int, gn_impl: str = "auto"):
     if timm_name == "resnetv2_50x1_bit_distilled":
         from dorpatch_tpu.models.resnetv2 import resnetv2_50x1
 
-        return resnetv2_50x1(num_classes)
+        return resnetv2_50x1(num_classes, gn_impl=gn_impl)
     if timm_name == "vit_base_patch16_224":
         from dorpatch_tpu.models.vit import vit_base_patch16
 
@@ -101,6 +101,7 @@ def get_model(
     model_dir: str = "pretrained_models/",
     img_size: int = 224,
     seed: int = 0,
+    gn_impl: str = "auto",
 ) -> Victim:
     """Build the victim for a dataset (`utils.py:47-63` + `NormModel`).
 
@@ -108,10 +109,13 @@ def get_model(
     when present; otherwise falls back to deterministic random initialization
     (for environments without the PatchCleanser checkpoints — synthetic mode,
     tests, benchmarks).
+
+    gn_impl selects the GroupNorm+ReLU implementation for ResNetV2 victims
+    (see `models.resnetv2.GroupNormRelu`); other architectures ignore it.
     """
     timm_name = resolve_arch(arch)
     num_classes = NUM_CLASSES[dataset]
-    model = _build_flax(timm_name, num_classes)
+    model = _build_flax(timm_name, num_classes, gn_impl=gn_impl)
 
     ckpt = checkpoint_path(model_dir, dataset, timm_name)
     if os.path.exists(ckpt):

@@ -55,6 +55,20 @@ class StdConv(nn.Module):
         )
 
 
+class _GNParams(nn.Module):
+    """Shadow parameter holder for the fused GN path: declares `scale`/`bias`
+    under the same module name ("GroupNorm_0") and shapes/dtypes/initializers
+    as `nn.GroupNorm`, so both impls share one checkpoint-compatible tree."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        scale = self.param("scale", nn.initializers.ones, (self.features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
+        return scale, bias
+
+
 class GroupNormRelu(nn.Module):
     """GroupNorm(32, eps=1e-5) + ReLU (timm GroupNormAct).
 
@@ -63,15 +77,31 @@ class GroupNormRelu(nn.Module):
     attack's bfloat16 mixed precision the surrounding convs must see bf16
     activations, or every conv after the first GN silently runs on f32
     activations at 2x the HBM traffic (measured ~26 TFLOP/s vs ~60+ fixed).
+
+    impl: "auto" (fused Pallas kernel on single-device TPU backends — XLA's
+    GN *backward* costs ~23% of the attack step, see `ops/fused_gn.py` —
+    flax GroupNorm elsewhere), "flax", "pallas", "interpret", "jnp"
+    (the kernel's jnp twin; testing only).
     """
 
     num_groups: int = 32
+    impl: str = "auto"
 
     @nn.compact
     def __call__(self, x):
-        dt = x.dtype
-        x = nn.GroupNorm(num_groups=self.num_groups, epsilon=1e-5, dtype=jnp.float32)(x)
-        return nn.relu(x).astype(dt)
+        from dorpatch_tpu.ops import fused_gn
+
+        impl = self.impl
+        if impl == "auto":
+            impl = "pallas" if fused_gn.auto_pallas(x.shape) else "flax"
+        if impl == "flax":
+            dt = x.dtype
+            x = nn.GroupNorm(
+                num_groups=self.num_groups, epsilon=1e-5, dtype=jnp.float32,
+                name="GroupNorm_0")(x)
+            return nn.relu(x).astype(dt)
+        scale, bias = _GNParams(x.shape[-1], name="GroupNorm_0")()
+        return fused_gn.gn_relu(x, scale, bias, self.num_groups, impl=impl)
 
 
 class PreActBottleneck(nn.Module):
@@ -82,11 +112,12 @@ class PreActBottleneck(nn.Module):
     out_features: int
     stride: int = 1
     bottle_ratio: float = 0.25
+    gn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x):
         mid = int(round(self.out_features * self.bottle_ratio))
-        preact = GroupNormRelu(name="norm1")(x)
+        preact = GroupNormRelu(name="norm1", impl=self.gn_impl)(x)
         if x.shape[-1] != self.out_features or self.stride != 1:
             shortcut = StdConv(
                 self.out_features, (1, 1), (self.stride, self.stride), name="downsample_conv"
@@ -94,9 +125,9 @@ class PreActBottleneck(nn.Module):
         else:
             shortcut = x
         y = StdConv(mid, (1, 1), name="conv1")(preact)
-        y = GroupNormRelu(name="norm2")(y)
+        y = GroupNormRelu(name="norm2", impl=self.gn_impl)(y)
         y = StdConv(mid, (3, 3), (self.stride, self.stride), name="conv2")(y)
-        y = GroupNormRelu(name="norm3")(y)
+        y = GroupNormRelu(name="norm3", impl=self.gn_impl)(y)
         y = StdConv(self.out_features, (1, 1), name="conv3")(y)
         return y + shortcut
 
@@ -108,6 +139,7 @@ class ResNetV2(nn.Module):
     layers: Sequence[int] = (3, 4, 6, 3)
     width_factor: int = 1
     stem_features: int = 64
+    gn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x):
@@ -123,15 +155,16 @@ class ResNetV2(nn.Module):
             for bi in range(depth):
                 stride = 2 if (bi == 0 and si > 0) else 1
                 x = PreActBottleneck(
-                    features * wf, stride=stride, name=f"stage{si}_block{bi}"
+                    features * wf, stride=stride, name=f"stage{si}_block{bi}",
+                    gn_impl=self.gn_impl,
                 )(x)
             features *= 2
 
-        x = GroupNormRelu(name="norm")(x)
+        x = GroupNormRelu(name="norm", impl=self.gn_impl)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, name="head")(x)
         return x
 
 
-def resnetv2_50x1(num_classes: int) -> ResNetV2:
-    return ResNetV2(num_classes=num_classes)
+def resnetv2_50x1(num_classes: int, gn_impl: str = "auto") -> ResNetV2:
+    return ResNetV2(num_classes=num_classes, gn_impl=gn_impl)

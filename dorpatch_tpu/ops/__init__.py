@@ -1,5 +1,6 @@
 """Hand-fused TPU ops (Pallas) for the framework's hot inner-loop primitives."""
 
+from dorpatch_tpu.ops.fused_gn import gn_relu, gn_relu_reference
 from dorpatch_tpu.ops.masked_fill import masked_fill, masked_fill_reference
 
-__all__ = ["masked_fill", "masked_fill_reference"]
+__all__ = ["gn_relu", "gn_relu_reference", "masked_fill", "masked_fill_reference"]

@@ -1,0 +1,14 @@
+"""Shared backend detection for the Pallas dispatch heuristics."""
+
+from __future__ import annotations
+
+import jax
+
+
+def is_tpu_backend() -> bool:
+    """True when the default backend compiles Pallas Mosaic kernels
+    ("tpu" proper, or the remote-tunneled "axon" TPU platform)."""
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False

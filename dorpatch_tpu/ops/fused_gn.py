@@ -1,0 +1,252 @@
+"""Fused GroupNorm(32)+ReLU with a hand-written backward: the victim's
+bandwidth sink.
+
+ResNetV2-50 BiT runs 49 GroupNorm+ReLU pairs between its convs
+(`models/resnetv2.py`, timm `GroupNormAct`). On-chip attribution
+(`tools/profile_gn.py`, PERF.md round 3) shows XLA's GN costs only ~11% of
+the victim *forward* but ~23% of the attack's fwd+bwd step — the autodiff
+backward of the f32 normalize chain materializes several full-activation
+float32 intermediates in HBM. The arithmetic is trivial; the traffic is not.
+
+This module implements the pair as one Pallas kernel per direction with a
+`jax.custom_vjp`:
+
+- forward: one read of `x`, one write of `y`; per-(sample, group) statistics
+  computed in VMEM (f32, fast-variance `E[x^2]-E[x]^2` clipped at 0 —
+  exactly flax's `_compute_stats`), plus tiny `[N, G]` mean/rstd residual
+  outputs.
+- backward: reads `x`/`dy`, writes `dx` (the HBM lower bound), recomputing
+  `xhat` and the ReLU gate from the saved statistics in-register. Parameter
+  cotangents come out as per-sample `[N, C]` partials, summed outside the
+  kernel (they are two orders of magnitude smaller than the slabs).
+
+Group statistics never touch HBM mid-kernel: per-channel sums are reduced to
+per-group sums with a tiny `[C, G]` one-hot matmul (MXU-friendly; lane axis
+stays C), and broadcast back with its transpose.
+
+The grid is one program per sample; the largest slab (56x56x256 f32 in
+stage 0) is ~3.2 MB — comfortably VMEM-resident with double buffering.
+
+`gn_relu` dispatches like `ops.masked_fill`: "auto" uses Pallas on a
+single-device TPU backend and the jnp reference elsewhere. Under a
+multi-device mesh the jnp path is kept deliberately: a raw `pallas_call` is
+opaque to GSPMD and would block batch-sharding propagation through the
+victim forward (see `ops/masked_fill.py` for the shard_map treatment the
+EOT fill needed; the GN sits *inside* the model, where XLA's own fusion is
+the partitionable choice).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def gn_relu_reference(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                      num_groups: int, eps: float = 1e-5) -> jax.Array:
+    """jnp reference: flax `GroupNorm(dtype=f32)` + ReLU + cast to x.dtype.
+
+    Mirrors flax's op ordering (`_normalize`): fast variance clipped at 0,
+    `y = (x - mean) * (rsqrt(var + eps) * scale) + bias`.
+    """
+    dt = x.dtype
+    n, h, w, c = x.shape
+    g = num_groups
+    gs = c // g
+    xf = x.astype(jnp.float32).reshape(n, h * w, g, gs)
+    mean = jnp.mean(xf, axis=(1, 3), keepdims=True)          # [N,1,G,1]
+    msq = jnp.mean(xf * xf, axis=(1, 3), keepdims=True)
+    var = jnp.maximum(msq - mean * mean, 0.0)
+    mul = jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32).reshape(1, 1, g, gs)
+    y = (xf - mean) * mul + bias.astype(jnp.float32).reshape(1, 1, g, gs)
+    return jax.nn.relu(y).reshape(n, h, w, c).astype(dt)
+
+
+# ---------------------------------------------------------------- kernels
+
+
+def _group_matrices(c: int, g: int):
+    """`gm [C, G]` one-hot channel->group; built from iotas in-kernel."""
+    ch = jax.lax.broadcasted_iota(jnp.int32, (c, g), 0)
+    gr = jax.lax.broadcasted_iota(jnp.int32, (c, g), 1)
+    return (ch // (c // g) == gr).astype(jnp.float32)
+
+
+def _fwd_kernel(g: int, eps: float, x_ref, s_ref, b_ref,
+                y_ref, mean_ref, rstd_ref):
+    xf = x_ref[0].astype(jnp.float32)                        # [HW, C]
+    hw, c = xf.shape
+    cnt = float(hw * (c // g))
+    gm = _group_matrices(c, g)                               # [C, G]
+    s1 = jnp.sum(xf, axis=0, keepdims=True)                  # [1, C]
+    s2 = jnp.sum(xf * xf, axis=0, keepdims=True)
+    mean_g = jnp.dot(s1, gm, preferred_element_type=jnp.float32) / cnt
+    msq_g = jnp.dot(s2, gm, preferred_element_type=jnp.float32) / cnt
+    var_g = jnp.maximum(msq_g - mean_g * mean_g, 0.0)
+    rstd_g = jax.lax.rsqrt(var_g + eps)                      # [1, G]
+    mean_c = jnp.dot(mean_g, gm.T, preferred_element_type=jnp.float32)
+    mul_c = jnp.dot(rstd_g, gm.T, preferred_element_type=jnp.float32) * s_ref[...]
+    y = (xf - mean_c) * mul_c + b_ref[...]
+    y_ref[0] = jnp.maximum(y, 0.0).astype(y_ref.dtype)
+    mean_ref[0] = mean_g
+    rstd_ref[0] = rstd_g
+
+
+def _bwd_kernel(g: int, x_ref, dy_ref, s_ref, b_ref, mean_ref, rstd_ref,
+                dx_ref, ds_ref, db_ref):
+    xf = x_ref[0].astype(jnp.float32)                        # [HW, C]
+    hw, c = xf.shape
+    cnt = float(hw * (c // g))
+    gm = _group_matrices(c, g)
+    mean_c = jnp.dot(mean_ref[0], gm.T, preferred_element_type=jnp.float32)
+    rstd_c = jnp.dot(rstd_ref[0], gm.T, preferred_element_type=jnp.float32)
+    xhat = (xf - mean_c) * rstd_c
+    gate = xhat * s_ref[...] + b_ref[...] > 0.0
+    dyr = jnp.where(gate, dy_ref[0].astype(jnp.float32), 0.0)
+    db_c = jnp.sum(dyr, axis=0, keepdims=True)               # [1, C]
+    ds_c = jnp.sum(dyr * xhat, axis=0, keepdims=True)
+    # per-group sums of dxhat (= dyr * scale) and dxhat * xhat
+    a_g = jnp.dot(db_c * s_ref[...], gm, preferred_element_type=jnp.float32)
+    b_g = jnp.dot(ds_c * s_ref[...], gm, preferred_element_type=jnp.float32)
+    a_c = jnp.dot(a_g, gm.T, preferred_element_type=jnp.float32)
+    b_c = jnp.dot(b_g, gm.T, preferred_element_type=jnp.float32)
+    dx = rstd_c * (dyr * s_ref[...] - (a_c + xhat * b_c) / cnt)
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+    ds_ref[0] = ds_c
+    db_ref[0] = db_c
+
+
+def _pallas_fwd(x, scale, bias, g: int, eps: float, interpret: bool):
+    n, h, w, c = x.shape
+    hw = h * w
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, g, eps),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            # stats are [N, 1, G]: Mosaic requires the last two block dims to
+            # divide (8, 128) or equal the array dims — the singleton middle
+            # axis makes the (1, G) tail exact
+            pl.BlockSpec((1, 1, g), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, g), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, hw, c), x.dtype),
+            jax.ShapeDtypeStruct((n, 1, g), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, g), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x.reshape(n, hw, c),
+      scale.astype(jnp.float32).reshape(1, c),
+      bias.astype(jnp.float32).reshape(1, c))
+    return y.reshape(n, h, w, c), mean, rstd
+
+
+def _pallas_bwd(x, dy, scale, bias, mean, rstd, g: int, interpret: bool):
+    n, h, w, c = x.shape
+    hw = h * w
+    dx, ds_p, db_p = pl.pallas_call(
+        functools.partial(_bwd_kernel, g),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1, g), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, g), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, c), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, hw, c), x.dtype),
+            jax.ShapeDtypeStruct((n, 1, c), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x.reshape(n, hw, c), dy.reshape(n, hw, c),
+      scale.astype(jnp.float32).reshape(1, c),
+      bias.astype(jnp.float32).reshape(1, c), mean, rstd)
+    return (dx.reshape(n, h, w, c),
+            jnp.sum(ds_p, axis=(0, 1)), jnp.sum(db_p, axis=(0, 1)))
+
+
+# ------------------------------------------------------------- custom vjp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _gn_relu_pallas(x, scale, bias, g: int, eps: float, interpret: bool):
+    return _pallas_fwd(x, scale, bias, g, eps, interpret)[0]
+
+
+def _vjp_fwd(x, scale, bias, g: int, eps: float, interpret: bool):
+    y, mean, rstd = _pallas_fwd(x, scale, bias, g, eps, interpret)
+    return y, (x, scale, bias, mean, rstd)
+
+
+def _vjp_bwd(g: int, eps: float, interpret: bool, res, dy):
+    x, scale, bias, mean, rstd = res
+    dx, ds, db = _pallas_bwd(x, dy, scale, bias, mean, rstd, g, interpret)
+    return dx, ds.astype(scale.dtype), db.astype(bias.dtype)
+
+
+_gn_relu_pallas.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# Largest per-sample [HW, C] slab the kernels take whole (no spatial
+# tiling): the backward holds a handful of f32 slab temporaries in VMEM
+# (~16 MB/core on v5e), so gate at 4 MB — admits every layer of the
+# 224-resolution victims (max slab 56*56*256*4 = 3.2 MB) and falls back to
+# the XLA path for larger image sizes instead of failing Mosaic compile.
+_MAX_SLAB_BYTES = 4 * 1024 * 1024
+
+
+def auto_pallas(x_shape=None) -> bool:
+    """Dispatch predicate for impl="auto": the Pallas kernel on a
+    single-device TPU backend (and, when `x_shape` [N,H,W,C] is given,
+    only when the per-sample slab fits the kernels' VMEM budget); the
+    GSPMD-partitionable path elsewhere."""
+    from dorpatch_tpu.ops._backend import is_tpu_backend
+
+    try:
+        ok = is_tpu_backend() and jax.device_count() == 1
+    except Exception:
+        return False
+    if ok and x_shape is not None:
+        n, h, w, c = x_shape
+        ok = h * w * c * 4 <= _MAX_SLAB_BYTES
+    return ok
+
+
+def gn_relu(x: jax.Array, scale: jax.Array, bias: jax.Array,
+            num_groups: int = 32, eps: float = 1e-5,
+            impl: str = "auto") -> jax.Array:
+    """GroupNorm(num_groups, eps)+ReLU on NHWC `x`, output in `x.dtype`.
+
+    f32 statistics regardless of `x.dtype` (flax/timm parity). `scale`/`bias`
+    are the `[C]` affine parameters. Differentiable w.r.t. all three.
+
+    impl: "auto" (Pallas on single-device TPU backends, jnp elsewhere),
+    "pallas", "interpret" (Pallas interpreter — CPU tests), "jnp".
+    """
+    if x.shape[-1] % num_groups:
+        raise ValueError(f"C={x.shape[-1]} not divisible by {num_groups} groups")
+    if impl == "auto":
+        impl = "pallas" if auto_pallas(x.shape) else "jnp"
+    if impl == "jnp":
+        return gn_relu_reference(x, scale, bias, num_groups, eps)
+    if impl not in ("pallas", "interpret"):
+        raise ValueError(f"impl={impl!r}")
+    return _gn_relu_pallas(x, scale, bias, num_groups, float(eps),
+                           impl == "interpret")

@@ -147,10 +147,9 @@ _masked_fill_pallas.defvjp(_vjp_fwd, _vjp_bwd)
 def _auto_use_pallas() -> bool:
     """Pallas iff the backend is a TPU (the Mosaic kernel does not lower on
     CPU outside interpreter mode)."""
-    try:
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:
-        return False
+    from dorpatch_tpu.ops._backend import is_tpu_backend
+
+    return is_tpu_backend()
 
 
 # --------------------------------------------------------- shard_map wrapper

@@ -66,3 +66,21 @@ def test_unknown_bench_mode_yields_error_json(mode):
     rec = json.loads(out.stdout.strip().splitlines()[-1])
     assert "error" in rec and mode in rec["error"]
     assert rec["value"] == 0.0
+
+
+def test_unknown_bench_gn_yields_error_json(monkeypatch, capsys):
+    """BENCH_GN is validated at orchestrator entry (same convention as
+    BENCH_MODE) instead of failing deep inside the jax child at first
+    model trace; empty means auto."""
+    for var in ("BENCH_MODE", "BENCH_EOT", "BENCH_IMG", "BENCH_ARCH"):
+        monkeypatch.delenv(var, raising=False)  # hermetic vs ambient BENCH_*
+    monkeypatch.setenv("BENCH_GN", "fused")
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "BENCH_GN" in rec["error"] and rec["value"] == 0.0
+
+    monkeypatch.setenv("BENCH_GN", "")
+    monkeypatch.setattr(bench, "run_child", lambda *a, **k: None)
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["error"] == "benchmark could not run"  # not the GN error

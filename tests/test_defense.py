@@ -93,6 +93,63 @@ def test_masked_predictions_matches_direct():
     np.testing.assert_array_equal(got, want)
 
 
+def test_masked_predictions_chunk_bound_invariant():
+    """`chunk_size` is an upper bound with equalized chunks (padding-free
+    where possible); results must not depend on the bound chosen."""
+    spec = masks_lib.geometry(32, 0.12)
+    singles, doubles = masks_lib.mask_sets(spec)
+    k = max(singles.shape[1], doubles.shape[1])
+    rects = jnp.asarray(np.concatenate(
+        [masks_lib.pad_rects(singles, k), masks_lib.pad_rects(doubles, k)]))
+
+    def apply_fn(params, x):
+        s = x.mean(axis=(1, 2, 3))
+        return jax.nn.one_hot((s * 7).astype(jnp.int32) % 5, 5)
+
+    imgs = jax.random.uniform(jax.random.PRNGKey(2), (2, 32, 32, 3))
+    outs = [
+        np.asarray(masked_predictions(apply_fn, None, imgs, rects, chunk_size=c))
+        for c in (128, 111, rects.shape[0], 1)
+    ]
+    for o in outs[1:]:
+        np.testing.assert_array_equal(outs[0], o)
+
+
+def test_masked_predictions_empty_rects():
+    """n=0 masks -> empty [B, 0] prediction table, no division by zero."""
+    def apply_fn(params, x):
+        return jnp.zeros((x.shape[0], 5))
+
+    imgs = jnp.zeros((2, 32, 32, 3))
+    rects = jnp.zeros((0, 1, 4), jnp.int32)
+    got = masked_predictions(apply_fn, None, imgs, rects, chunk_size=8)
+    assert got.shape == (2, 0)
+
+
+def test_masked_predictions_mesh_chunk_rounding():
+    """Under a multi-device mesh the equalized chunk must stay divisible by
+    the mask-axis size (keeps the sharded Pallas fill on its fast path) and
+    results must match the unmeshed run."""
+    from dorpatch_tpu import parallel
+
+    mesh = parallel.make_mesh(data=2, mask=4)
+    spec = masks_lib.geometry(32, 0.12)
+    singles, doubles = masks_lib.mask_sets(spec)
+    k = max(singles.shape[1], doubles.shape[1])
+    rects = jnp.asarray(np.concatenate(
+        [masks_lib.pad_rects(singles, k), masks_lib.pad_rects(doubles, k)]))
+
+    def apply_fn(params, x):
+        s = x.mean(axis=(1, 2, 3))
+        return jax.nn.one_hot((s * 7).astype(jnp.int32) % 5, 5)
+
+    imgs = jax.random.uniform(jax.random.PRNGKey(3), (2, 32, 32, 3))
+    want = np.asarray(masked_predictions(apply_fn, None, imgs, rects, 128))
+    got = np.asarray(masked_predictions(
+        apply_fn, None, imgs, rects, 128, mesh=mesh))
+    np.testing.assert_array_equal(got, want)
+
+
 # ---------- stub-model end-to-end ----------
 
 @pytest.fixture(scope="module")

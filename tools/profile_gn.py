@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Bound the GroupNorm+ReLU cost in the RN50-BiT forward/backward on-chip.
+
+PERF.md attributes the steady-state step almost entirely to the victim
+fwd+bwd at ~41% MFU and names "GroupNorm/elementwise bandwidth between the
+convs" as the residual. Before writing a fused Pallas GN kernel, measure the
+actual headroom: time the same scan-threaded fwd / fwd+bwd programs
+(tools/profile_scan.py methodology) for
+
+  gn       — the real model (GroupNormRelu: f32 stats, bf16 out)
+  identity — GroupNormRelu monkeypatched to plain ReLU (no stats, no
+             normalize, no f32 round-trip)
+
+The gn→identity delta is the *upper bound* on what any GN fusion can
+recover (a real kernel still reads/writes the slab once). If the delta is
+small, the forward is conv-bound and the kernel isn't worth building.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu.models import resnetv2
+
+
+def timed_scan(name, fn, args, k, flops_per_iter=None, reps=2):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    per_iter = (time.perf_counter() - t0) / (reps * k)
+    tfs = (f"  {flops_per_iter / per_iter / 1e12:7.2f} TFLOP/s"
+           if flops_per_iter else "")
+    print(f"{name:42s} {per_iter * 1e3:9.1f} ms/iter  (compile {compile_s:.0f}s){tfs}",
+          flush=True)
+    return per_iter
+
+
+class _FlaxNorm(resnetv2.GroupNormRelu):
+    """Force the flax GroupNorm path (the pre-round-3 baseline; "auto" now
+    resolves to the Pallas kernel on single-device TPU backends)."""
+
+    @resnetv2.nn.compact
+    def __call__(self, x):  # noqa: D102
+        dt = x.dtype
+        x = resnetv2.nn.GroupNorm(
+            num_groups=self.num_groups, epsilon=1e-5,
+            dtype=resnetv2.jnp.float32, name="GroupNorm_0")(x)
+        return resnetv2.nn.relu(x).astype(dt)
+
+
+class _IdentityNorm(resnetv2.GroupNormRelu):
+    """ReLU only: removes GN stats/normalize and the f32 round-trip."""
+
+    @resnetv2.nn.compact
+    def __call__(self, x):  # noqa: D102
+        return resnetv2.nn.relu(x)
+
+
+class _FusedNorm(resnetv2.GroupNormRelu):
+    """The fused Pallas custom-VJP kernel (`ops/fused_gn.py`)."""
+
+    @resnetv2.nn.compact
+    def __call__(self, x):  # noqa: D102
+        from dorpatch_tpu.ops import fused_gn
+
+        scale, bias = resnetv2._GNParams(x.shape[-1], name="GroupNorm_0")()
+        return fused_gn.gn_relu(x, scale, bias, self.num_groups, impl="pallas")
+
+
+def build(variant: str, img: int, n: int, k: int):
+    # NOTE: the patch must stay active while the returned fns trace (first
+    # call), so the caller patches for the whole variant block; this only
+    # selects the class.
+    model = resnetv2.resnetv2_50x1(num_classes=1000)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, img, img, 3), jnp.bfloat16))
+    params = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a, params)
+
+    @jax.jit
+    def fwd_scan(x0):
+        def body(x, _):
+            logits = model.apply(params, x)
+            return x + logits.mean().astype(x.dtype) * 1e-9, None
+        return jax.lax.scan(body, x0, None, length=k)[0]
+
+    @jax.jit
+    def fwdbwd_scan(x0):
+        def body(x, _):
+            g = jax.grad(
+                lambda xx: model.apply(params, xx).astype(jnp.float32).mean()
+            )(x)
+            return jnp.clip(x - 0.01 * jnp.sign(g), 0, 1), None
+        return jax.lax.scan(body, x0, None, length=k)[0]
+
+    return fwd_scan, fwdbwd_scan
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=256, help="masked-image batch")
+    p.add_argument("--img", type=int, default=224)
+    p.add_argument("--k", type=int, default=8)
+    p.add_argument("--variants", default="gn,identity")
+    args = p.parse_args()
+    n, img, k = args.n, args.img, args.k
+
+    print(f"devices: {jax.devices()}  n={n} img={img} k={k}", flush=True)
+    xb = jax.random.uniform(jax.random.PRNGKey(1), (n, img, img, 3),
+                            jnp.bfloat16)
+    gflops = n * 8.0e9  # XLA cost-model fwd FLOPs/img @224 (PERF.md)
+
+    orig = resnetv2.GroupNormRelu
+    for variant in args.variants.split(","):
+        resnetv2.GroupNormRelu = {
+            "gn": _FlaxNorm, "identity": _IdentityNorm,
+            "fused": _FusedNorm}[variant]
+        try:
+            fwd, fwdbwd = build(variant, img, n, k)
+            timed_scan(f"[{variant}] fwd-only scan", fwd, (xb,), k, gflops)
+            timed_scan(f"[{variant}] fwd+bwd scan", fwdbwd, (xb,), k,
+                       3 * gflops)
+        finally:
+            resnetv2.GroupNormRelu = orig
+
+
+if __name__ == "__main__":
+    main()
