@@ -37,9 +37,10 @@ per jitted block), BENCH_REPS (3 timed blocks), BENCH_WARMUP (3 untimed
 steady-state warm-up calls after compile — see the warm-up note in
 `child_jax`), BENCH_TORCH_ITERS (3), BENCH_ARCH / BENCH_DATASET / BENCH_IMG
 (model selection), BENCH_REMAT (0/1, default 0 = no remat, auto-falls-back
-to 1 on OOM), BENCH_GN (GroupNorm impl for ResNetV2 victims: "auto" =
-fused Pallas kernel on single-chip TPU, "flax" = XLA path — see
-ops/fused_gn.py), BENCH_PEAK_TFLOPS, BENCH_JAX_TIMEOUT (seconds, default 1200),
+to 1 on OOM), BENCH_REMAT_POLICY (full|conv|dots — what an active remat
+recomputes, see AttackConfig.remat_policy), BENCH_GN (GroupNorm impl for
+ResNetV2 victims: "auto" = fused Pallas kernel on single-chip TPU, "flax" =
+XLA path — see ops/fused_gn.py), BENCH_PEAK_TFLOPS, BENCH_JAX_TIMEOUT (seconds, default 1200),
 BENCH_TORCH_TIMEOUT (default 600).
 """
 
@@ -189,7 +190,9 @@ def child_jax() -> None:
     def run(batch: int, remat: bool) -> dict:
         victim = get_model(dataset, arch, img_size=img,
                            gn_impl=os.environ.get("BENCH_GN") or "auto")
-        cfg = AttackConfig(sampling_size=eot, compute_dtype=dtype)
+        cfg = AttackConfig(sampling_size=eot, compute_dtype=dtype,
+                           remat_policy=os.environ.get(
+                               "BENCH_REMAT_POLICY") or "full")
         attack = DorPatch(victim.apply, victim.params, victim.num_classes, cfg,
                           remat=remat)
         universe = jnp.asarray(
@@ -382,6 +385,13 @@ def main() -> None:
                           "unit": "images/sec", "vs_baseline": 0.0,
                           "error": f"unknown BENCH_MODE={mode!r} "
                                    "(use 'attack' or 'certify')"}))
+        return
+    rp = os.environ.get("BENCH_REMAT_POLICY") or "full"
+    if rp not in ("full", "conv", "dots"):
+        print(json.dumps({"metric": "patch-opt images/sec", "value": 0.0,
+                          "unit": "images/sec", "vs_baseline": 0.0,
+                          "error": f"unknown BENCH_REMAT_POLICY={rp!r} "
+                                   "(use 'full', 'conv' or 'dots')"}))
         return
     gn = os.environ.get("BENCH_GN") or "auto"
     if gn not in ("auto", "flax", "pallas", "interpret", "jnp"):

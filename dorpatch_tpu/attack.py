@@ -185,6 +185,8 @@ class DorPatch:
             raise ValueError(f"compute_dtype={cfg.compute_dtype!r}")
         if cfg.remat not in ("auto", "on", "off"):
             raise ValueError(f"remat={cfg.remat!r}")
+        if cfg.remat_policy not in ("full", "conv", "dots"):
+            raise ValueError(f"remat_policy={cfg.remat_policy!r}")
         self._fwd = fwd
         self._sampling_size = cfg.sampling_size
         # jitted program cache: (stage, img_size, n_steps) -> block fn, plus
@@ -221,20 +223,31 @@ class DorPatch:
     def _grad_fwd(self, n_masked: int):
         """The forward used under `jax.grad`, with the remat policy applied.
 
-        Rematerialization re-runs the forward during the backward (~25% more
-        FLOPs) to avoid storing activations; it only pays when the masked
-        batch would not fit HBM. `remat=None` follows `config.remat`:
-        "on"/"off" force it, "auto" remats when `n_masked` (images x EOT
-        samples) exceeds `config.remat_threshold`. The failure sweeps and
-        certification never differentiate, so they always use the plain
-        forward."""
+        Rematerialization re-runs forward work during the backward to avoid
+        storing activations; it only pays when the masked batch would not
+        fit HBM. `remat=None` follows `config.remat`: "on"/"off" force it,
+        "auto" remats when `n_masked` (images x EOT samples) exceeds
+        `config.remat_threshold`. `config.remat_policy` picks what is
+        recomputed: "full" replays the whole forward (~25% extra step time,
+        minimum memory), "conv" keeps the conv outputs and replays only the
+        normalize/elementwise chains between them (a few-percent tax;
+        memory ~= conv outputs), "dots" keeps matmul outputs (the ViT /
+        ResMLP analog). The failure sweeps and certification never
+        differentiate, so they always use the plain forward."""
         if self.remat is not None:
             use = self.remat
         else:
             cfg = self.config
             use = cfg.remat == "on" or (
                 cfg.remat == "auto" and n_masked > cfg.remat_threshold)
-        return jax.checkpoint(self._fwd) if use else self._fwd
+        if not use:
+            return self._fwd
+        policy = {
+            "full": None,  # jax.checkpoint's default: save nothing
+            "conv": jax.checkpoint_policies.save_only_these_names("conv_out"),
+            "dots": jax.checkpoint_policies.dots_saveable,
+        }[self.config.remat_policy]
+        return jax.checkpoint(self._fwd, policy=policy)
 
     # ---------- mask sampling (static shapes) ----------
 

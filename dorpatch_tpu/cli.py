@@ -65,6 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rematerialize the EOT forward in the backward "
                         "(memory for ~25%% step time; auto: only when the "
                         "masked batch exceeds the remat threshold)")
+    p.add_argument("--remat-policy", default="full",
+                   choices=["full", "conv", "dots"],
+                   help="what an active remat recomputes: full = the whole "
+                        "forward; conv = keep conv outputs, replay only the "
+                        "normalize chains (ResNetV2); dots = keep matmul "
+                        "outputs (ViT/ResMLP)")
     return p
 
 
@@ -88,6 +94,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         use_pallas=args.use_pallas,
         compute_dtype=args.compute_dtype,
         remat=args.remat,
+        remat_policy=args.remat_policy,
     )
     return ExperimentConfig(
         dataset=args.dataset,

@@ -71,6 +71,16 @@ class AttackConfig:
     remat_threshold: int = 512                 # masked-batch size above which "auto" remats
                                                # (512 masked images @224 RN50 bf16 measured
                                                # to fit v5e HBM without remat — PERF.md)
+    remat_policy: str = "full"                 # what the backward recomputes when remat is
+                                               # active: "full" re-runs the whole forward
+                                               # (stores only inputs; ~25-33% extra FLOPs);
+                                               # "conv" saves conv outputs (tagged
+                                               # `checkpoint_name("conv_out")` in StdConv)
+                                               # and recomputes only the cheap normalize/
+                                               # elementwise chains — activation memory ~=
+                                               # the conv outputs (~19 MB/masked image for
+                                               # RN50@224 bf16) for a few-percent tax;
+                                               # "dots" saves matmul outputs (ViT/ResMLP)
 
     @property
     def scale_down(self) -> float:

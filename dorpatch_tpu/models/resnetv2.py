@@ -25,6 +25,7 @@ from typing import Sequence, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 
 class StdConv(nn.Module):
@@ -46,13 +47,17 @@ class StdConv(nn.Module):
         mean = jnp.mean(kernel, axis=(0, 1, 2), keepdims=True)
         var = jnp.var(kernel, axis=(0, 1, 2), keepdims=True)
         kernel = (kernel - mean) * jax.lax.rsqrt(var + self.eps)
-        return jax.lax.conv_general_dilated(
+        out = jax.lax.conv_general_dilated(
             x,
             kernel.astype(x.dtype),
             window_strides=self.strides,
             padding="SAME",
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
+        # no-op outside jax.checkpoint; under the "conv" remat policy
+        # (attack.py:_grad_fwd) the tag makes conv outputs saveable so the
+        # backward replays only the cheap chains between convs
+        return checkpoint_name(out, "conv_out")
 
 
 class _GNParams(nn.Module):

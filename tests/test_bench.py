@@ -68,6 +68,24 @@ def test_unknown_bench_mode_yields_error_json(mode):
     assert rec["value"] == 0.0
 
 
+def test_unknown_bench_remat_policy_yields_error_json(monkeypatch, capsys):
+    """BENCH_REMAT_POLICY is validated at orchestrator entry; empty means
+    the full-remat default."""
+    for var in ("BENCH_MODE", "BENCH_GN", "BENCH_EOT", "BENCH_IMG",
+                "BENCH_ARCH"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("BENCH_REMAT_POLICY", "convs")
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "BENCH_REMAT_POLICY" in rec["error"] and rec["value"] == 0.0
+
+    monkeypatch.setenv("BENCH_REMAT_POLICY", "")
+    monkeypatch.setattr(bench, "run_child", lambda *a, **k: None)
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["error"] == "benchmark could not run"
+
+
 def test_unknown_bench_gn_yields_error_json(monkeypatch, capsys):
     """BENCH_GN is validated at orchestrator entry (same convention as
     BENCH_MODE) instead of failing deep inside the jax child at first
