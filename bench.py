@@ -386,16 +386,19 @@ def main() -> None:
                           "error": f"unknown BENCH_MODE={mode!r} "
                                    "(use 'attack' or 'certify')"}))
         return
+    # mode is validated: label misconfiguration rows with the right series
+    err_metric = ("PatchCleanser certifications/sec" if mode == "certify"
+                  else "patch-opt images/sec")
     rp = os.environ.get("BENCH_REMAT_POLICY") or "full"
     if rp not in ("full", "conv", "dots"):
-        print(json.dumps({"metric": "patch-opt images/sec", "value": 0.0,
+        print(json.dumps({"metric": err_metric, "value": 0.0,
                           "unit": "images/sec", "vs_baseline": 0.0,
                           "error": f"unknown BENCH_REMAT_POLICY={rp!r} "
                                    "(use 'full', 'conv' or 'dots')"}))
         return
     gn = os.environ.get("BENCH_GN") or "auto"
     if gn not in ("auto", "flax", "pallas", "interpret", "jnp"):
-        print(json.dumps({"metric": "patch-opt images/sec", "value": 0.0,
+        print(json.dumps({"metric": err_metric, "value": 0.0,
                           "unit": "images/sec", "vs_baseline": 0.0,
                           "error": f"unknown BENCH_GN={gn!r} (use 'auto', "
                                    "'flax', 'pallas', 'interpret' or 'jnp')"}))

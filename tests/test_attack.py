@@ -386,3 +386,28 @@ def test_grad_fwd_applies_policy():
         grads.append(np.asarray(g))
     np.testing.assert_allclose(grads[1], grads[0], rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(grads[2], grads[0], rtol=1e-5, atol=1e-5)
+
+
+def test_conv_policy_skips_conv_recompute_in_hlo():
+    """Static proof of the "conv" policy's FLOP savings: the compiled
+    grad graph under full remat re-runs the forward convolutions, while
+    save_only_these_names("conv_out") keeps the conv count at the
+    un-rematerialized graph's level (only the cheap chains are replayed)."""
+    from dorpatch_tpu.models.resnetv2 import ResNetV2
+
+    model = ResNetV2(num_classes=5, layers=(1, 1), gn_impl="flax")
+    x = jnp.zeros((2, 32, 32, 3))
+    params = model.init(jax.random.PRNGKey(0), x)
+    fwd = lambda x: model.apply(params, x)
+
+    def conv_count(fn):
+        txt = jax.jit(jax.grad(
+            lambda x: jnp.sum(fn(x) ** 2))).lower(x).compile().as_text()
+        return txt.count(" convolution(")
+
+    plain = conv_count(fwd)
+    full = conv_count(jax.checkpoint(fwd))
+    conv = conv_count(jax.checkpoint(
+        fwd, policy=jax.checkpoint_policies.save_only_these_names("conv_out")))
+    assert full > plain          # full remat recomputes forward convs
+    assert conv == plain         # conv policy does not

@@ -1,0 +1,64 @@
+"""Shape coverage for the fused GN kernel: every (HW, C) slab the RN50-BiT
+victim will hand the kernel on TPU, plus the VMEM gate boundary.
+
+Run in the kernel's jnp twin (identical math, fast on CPU) for the full
+sweep and interpret mode for a representative large/small pair — so an
+on-chip Mosaic compile of the victim encounters no slab geometry this suite
+has not pinned.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dorpatch_tpu.ops import fused_gn
+
+# (H, W, C) of every distinct GroupNormRelu input in ResNetV2-50x1 @224
+# (stem 64ch at 56x56 after pool; per-stage norm1/norm2/norm3 shapes; final
+# norm at 7x7x2048). Derived from models/resnetv2.py layer arithmetic.
+RN50_GN_SHAPES = sorted({
+    (56, 56, 64), (56, 56, 256),
+    (28, 28, 128), (56, 56, 128), (28, 28, 512),
+    (14, 14, 256), (28, 28, 256), (14, 14, 1024),
+    (7, 7, 512), (14, 14, 512), (7, 7, 2048),
+})
+
+
+def _flax(x, scale, bias):
+    import flax.linen as nn
+
+    y = nn.GroupNorm(num_groups=32, epsilon=1e-5, dtype=jnp.float32).apply(
+        {"params": {"scale": scale, "bias": bias}}, x)
+    return nn.relu(y).astype(x.dtype)
+
+
+@pytest.mark.parametrize("shape", RN50_GN_SHAPES)
+def test_all_rn50_slabs_jnp(shape):
+    h, w, c = shape
+    k = jax.random.PRNGKey(hash(shape) % (2**31))
+    x = jax.random.normal(k, (2, h, w, c), jnp.float32).astype(jnp.bfloat16)
+    scale = jnp.linspace(0.5, 1.5, c)
+    bias = jnp.linspace(-0.2, 0.2, c)
+    got = fused_gn.gn_relu(x, scale, bias, 32, impl="jnp")
+    want = _flax(x, scale, bias)
+    # bf16 outputs: reduction-order differences cost up to ~2 ulps, which
+    # scales with magnitude — combined rel+abs tolerance
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=0.016, atol=0.02)
+    # every RN50 slab must pass the VMEM gate (would compile as Pallas on TPU)
+    assert h * w * c * 4 <= fused_gn._MAX_SLAB_BYTES
+
+
+@pytest.mark.parametrize("shape", [(56, 56, 256), (7, 7, 2048)])
+def test_extreme_slabs_interpret(shape):
+    """Largest and most-channels slabs through the actual kernel
+    (interpreter): the exact grid/block geometry Mosaic will lower."""
+    h, w, c = shape
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, h, w, c), jnp.float32)
+    scale = jnp.ones((c,))
+    bias = jnp.zeros((c,))
+    got = fused_gn.gn_relu(x, scale, bias, 32, impl="interpret")
+    want = _flax(x, scale, bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)

@@ -81,10 +81,10 @@ class _FusedNorm(resnetv2.GroupNormRelu):
         return fused_gn.gn_relu(x, scale, bias, self.num_groups, impl="pallas")
 
 
-def build(variant: str, img: int, n: int, k: int):
-    # NOTE: the patch must stay active while the returned fns trace (first
-    # call), so the caller patches for the whole variant block; this only
-    # selects the class.
+def build(img: int, n: int, k: int):
+    # NOTE: the caller selects the variant by monkeypatching
+    # resnetv2.GroupNormRelu, and the patch must stay active while the
+    # returned fns trace (first call).
     model = resnetv2.resnetv2_50x1(num_classes=1000)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, img, img, 3), jnp.bfloat16))
@@ -131,7 +131,7 @@ def main():
             "gn": _FlaxNorm, "identity": _IdentityNorm,
             "fused": _FusedNorm}[variant]
         try:
-            fwd, fwdbwd = build(variant, img, n, k)
+            fwd, fwdbwd = build(img, n, k)
             timed_scan(f"[{variant}] fwd-only scan", fwd, (xb,), k, gflops)
             timed_scan(f"[{variant}] fwd+bwd scan", fwdbwd, (xb,), k,
                        3 * gflops)
