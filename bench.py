@@ -422,7 +422,7 @@ def main() -> None:
         arch, img = "resnet18", 32
         res = run_child("jax", jax_timeout, fallback)
     if res is None:
-        print(json.dumps({"metric": "patch-opt images/sec", "value": 0.0,
+        print(json.dumps({"metric": err_metric, "value": 0.0,
                           "unit": "images/sec", "vs_baseline": 0.0,
                           "error": "benchmark could not run"}))
         return

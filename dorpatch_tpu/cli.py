@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rematerialize the EOT forward in the backward "
                         "(memory for ~25%% step time; auto: only when the "
                         "masked batch exceeds the remat threshold)")
+    p.add_argument("--gn-impl", default="auto",
+                   choices=["auto", "flax", "pallas", "interpret", "jnp"],
+                   help="GroupNorm+ReLU impl for ResNetV2 victims (auto: "
+                        "fused Pallas kernel on single-chip TPU, flax "
+                        "elsewhere — see ops/fused_gn.py)")
     p.add_argument("--remat-policy", default="full",
                    choices=["full", "conv", "dots"],
                    help="what an active remat recomputes: full = the whole "
@@ -110,6 +115,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         results_root=args.results_root,
         synthetic_data=args.synthetic,
         img_size=args.img_size,
+        gn_impl=args.gn_impl,
         mesh_data=args.mesh_data,
         mesh_mask=args.mesh_mask,
         metrics_log=not args.no_metrics_log,

@@ -117,6 +117,10 @@ class ExperimentConfig:
     results_root: str = "results"
     synthetic_data: bool = False    # run without datasets on disk
     img_size: int = 224
+    gn_impl: str = "auto"           # GroupNorm+ReLU impl for ResNetV2 victims
+                                    # (models.resnetv2.GroupNormRelu): auto =
+                                    # fused Pallas kernel on single-chip TPU,
+                                    # flax elsewhere; force with flax|pallas
 
     # Mesh: data axis (images, DCN across slices) x mask axis (EOT samples, ICI).
     mesh_data: int = 1

@@ -62,7 +62,8 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
     utils.set_global_seed(cfg.seed)       # host RNGs (`utils.py:16-21`)
     utils.select_device(cfg.device)       # `--device` flag (`utils.py:12-13`)
     rng = np.random.default_rng(cfg.seed)
-    victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size)
+    victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size,
+                       gn_impl=cfg.gn_impl)
     store = ArtifactStore(results_path(cfg))
     logger = observe.AttackMetricsLogger(
         path=os.path.join(store.result_dir, "metrics.jsonl") if cfg.metrics_log else None,

@@ -52,7 +52,8 @@ def run_sweep(
     Row: the point's hyperparameters, robust accuracy (victim still correct
     under the patch), certified-ASR at `defense_ratio`, mean patch L2, and
     wall seconds."""
-    victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size)
+    victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size,
+                       gn_impl=cfg.gn_impl)
     x_np, y_np = next(iter(dataset_batches(
         cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size, cfg.seed,
         synthetic=cfg.synthetic_data,
