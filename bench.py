@@ -327,7 +327,14 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
 # ------------------------------------------------------------ orchestrator
 
 
+# why the last run_child returned None: "timeout" (accelerator wedged --
+# retrying a different software path cannot help) vs "crash"/"no-json"
+# (child-side failure -- a different code path may succeed)
+_CHILD_FAILURE = {"reason": None}
+
+
 def run_child(role: str, timeout_s: int, env_extra: dict) -> dict | None:
+    _CHILD_FAILURE["reason"] = None
     env = dict(os.environ)
     env["BENCH_ROLE"] = role
     env.update(env_extra)
@@ -352,17 +359,20 @@ def run_child(role: str, timeout_s: int, env_extra: dict) -> dict | None:
             proc.communicate(timeout=10)
         except subprocess.TimeoutExpired:
             pass
+        _CHILD_FAILURE["reason"] = "timeout"
         return None
     for line in err.splitlines():
         if "WARNING" not in line:
             log(f"[{role}] {line}")
     if proc.returncode != 0:
         log(f"{role} child failed (rc={proc.returncode})")
+        _CHILD_FAILURE["reason"] = "crash"
         return None
     try:
         return json.loads(out.strip().splitlines()[-1])
     except Exception:
         log(f"{role} child produced no JSON: {out[-300:]!r}")
+        _CHILD_FAILURE["reason"] = "no-json"
         return None
 
 
@@ -410,7 +420,21 @@ def main() -> None:
     img = int(os.environ.get("BENCH_IMG", "224"))
 
     fallback = None
+    gn_fallback = None
     res = run_child("jax", jax_timeout, {})
+    if (res is None and gn == "auto" and arch == "resnetv2"
+            and _CHILD_FAILURE["reason"] in ("crash", "no-json")):
+        # The auto path selects the fused Pallas GN kernel on single-chip
+        # TPU backends; if that child *crashed* (e.g. a Mosaic lowering
+        # quirk on this chip generation), fall back to the always-
+        # partitionable flax GN before abandoning the accelerator — the
+        # proven XLA path must not be lost to a kernel regression. A
+        # timeout means the accelerator is wedged: skip straight to the
+        # CPU fallback instead of burning a second jax_timeout.
+        log("jax child crashed with BENCH_GN=auto; retrying with flax GN")
+        res = run_child("jax", jax_timeout, {"BENCH_GN": "flax"})
+        if res is not None:
+            gn_fallback = "flax"
     if res is None:
         # Accelerator unreachable/wedged: CPU + small victim, so the driver
         # still gets a self-consistent (same-model) ratio row.
@@ -443,6 +467,10 @@ def main() -> None:
         "unit": "images/sec",
         "vs_baseline": round(res["ips"] / torch_ips, 2) if torch_ips else 0.0,
     }
+    if gn_fallback:
+        # make a benchmarked kernel regression visible in the recorded row
+        # (same convention as the CPU fallback's "fallback" field)
+        out["gn_fallback"] = gn_fallback
     if res.get("mfu") is not None:
         out["mfu"] = res["mfu"]
     for k in ("remat", "step_seconds", "fwd_gflops_per_image", "batch",
