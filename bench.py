@@ -40,7 +40,9 @@ steady-state warm-up calls after compile — see the warm-up note in
 to 1 on OOM), BENCH_REMAT_POLICY (full|conv|dots — what an active remat
 recomputes, see AttackConfig.remat_policy), BENCH_GN (GroupNorm impl for
 ResNetV2 victims: "auto" = fused Pallas kernel on single-chip TPU, "flax" =
-XLA path — see ops/fused_gn.py), BENCH_PEAK_TFLOPS, BENCH_JAX_TIMEOUT (seconds, default 1200),
+XLA path — see ops/fused_gn.py), BENCH_PEAK_TFLOPS, BENCH_JAX_TIMEOUT (seconds, default 1800 —
+first-time Mosaic kernel compiles through the remote tunnel can add many
+minutes),
 BENCH_TORCH_TIMEOUT (default 600).
 """
 
@@ -327,14 +329,11 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
 # ------------------------------------------------------------ orchestrator
 
 
-# why the last run_child returned None: "timeout" (accelerator wedged --
-# retrying a different software path cannot help) vs "crash"/"no-json"
-# (child-side failure -- a different code path may succeed)
-_CHILD_FAILURE = {"reason": None}
-
-
-def run_child(role: str, timeout_s: int, env_extra: dict) -> dict | None:
-    _CHILD_FAILURE["reason"] = None
+def run_child(role: str, timeout_s: int, env_extra: dict):
+    """-> (parsed JSON dict | None, reason). reason is None on success,
+    else "timeout" (accelerator wedged -- retrying a different software
+    path cannot help) vs "crash"/"no-json" (child-side failure -- a
+    different code path may succeed)."""
     env = dict(os.environ)
     env["BENCH_ROLE"] = role
     env.update(env_extra)
@@ -359,21 +358,18 @@ def run_child(role: str, timeout_s: int, env_extra: dict) -> dict | None:
             proc.communicate(timeout=10)
         except subprocess.TimeoutExpired:
             pass
-        _CHILD_FAILURE["reason"] = "timeout"
-        return None
+        return None, "timeout"
     for line in err.splitlines():
         if "WARNING" not in line:
             log(f"[{role}] {line}")
     if proc.returncode != 0:
         log(f"{role} child failed (rc={proc.returncode})")
-        _CHILD_FAILURE["reason"] = "crash"
-        return None
+        return None, "crash"
     try:
-        return json.loads(out.strip().splitlines()[-1])
+        return json.loads(out.strip().splitlines()[-1]), None
     except Exception:
         log(f"{role} child produced no JSON: {out[-300:]!r}")
-        _CHILD_FAILURE["reason"] = "no-json"
-        return None
+        return None, "no-json"
 
 
 def no_axon_env() -> dict:
@@ -414,16 +410,16 @@ def main() -> None:
                                    "'flax', 'pallas', 'interpret' or 'jnp')"}))
         return
     eot = int(os.environ.get("BENCH_EOT", "32"))
-    jax_timeout = int(os.environ.get("BENCH_JAX_TIMEOUT", "1200"))
+    jax_timeout = int(os.environ.get("BENCH_JAX_TIMEOUT", "1800"))
     torch_timeout = int(os.environ.get("BENCH_TORCH_TIMEOUT", "600"))
     arch = os.environ.get("BENCH_ARCH", "resnetv2")
     img = int(os.environ.get("BENCH_IMG", "224"))
 
     fallback = None
     gn_fallback = None
-    res = run_child("jax", jax_timeout, {})
+    res, why = run_child("jax", jax_timeout, {})
     if (res is None and gn == "auto" and arch == "resnetv2"
-            and _CHILD_FAILURE["reason"] in ("crash", "no-json")):
+            and why in ("crash", "no-json")):
         # The auto path selects the fused Pallas GN kernel on single-chip
         # TPU backends; if that child *crashed* (e.g. a Mosaic lowering
         # quirk on this chip generation), fall back to the always-
@@ -432,7 +428,7 @@ def main() -> None:
         # timeout means the accelerator is wedged: skip straight to the
         # CPU fallback instead of burning a second jax_timeout.
         log("jax child crashed with BENCH_GN=auto; retrying with flax GN")
-        res = run_child("jax", jax_timeout, {"BENCH_GN": "flax"})
+        res, _ = run_child("jax", jax_timeout, {"BENCH_GN": "flax"})
         if res is not None:
             gn_fallback = "flax"
     if res is None:
@@ -444,14 +440,14 @@ def main() -> None:
                     # fallback row honest
                     "BENCH_DTYPE": "float32", **no_axon_env()}
         arch, img = "resnet18", 32
-        res = run_child("jax", jax_timeout, fallback)
+        res, _ = run_child("jax", jax_timeout, fallback)
     if res is None:
         print(json.dumps({"metric": err_metric, "value": 0.0,
                           "unit": "images/sec", "vs_baseline": 0.0,
                           "error": "benchmark could not run"}))
         return
 
-    tres = run_child("torch", torch_timeout, fallback or {})
+    tres, _ = run_child("torch", torch_timeout, fallback or {})
     torch_ips = tres["ips"] if tres else None
     log(f"jax: {res['ips']:.3f} images/sec; torch baseline: {torch_ips}")
 

@@ -59,6 +59,18 @@ class PatchCleanserResult:
         self.predictions_2 = [r.preds_2 for r in records]
 
 
+def plan_chunks(n: int, chunk_size: int, mask_axis: int = 1):
+    """Split an n-long mask axis into (n_chunks, chunk) with chunk <=
+    chunk_size (hard memory bound), minimal padding, and — when possible —
+    chunk divisible by `mask_axis` (the mesh's mask-axis size, so the
+    sharded Pallas fill keeps its fast path). See `masked_predictions`."""
+    m = mask_axis if chunk_size >= mask_axis else 1
+    quantum = (chunk_size // m) * m              # largest multiple of m <= bound
+    n_chunks = -(-n // quantum) if n else 0
+    chunk = m * -(-n // (m * n_chunks)) if n_chunks else chunk_size
+    return n_chunks, chunk
+
+
 def masked_predictions(
     apply_fn: Callable[[Any, jax.Array], jax.Array],
     params: Any,
@@ -94,12 +106,7 @@ def masked_predictions(
     if mesh is not None and getattr(mesh, "devices", None) is not None \
             and mesh.devices.size > 1:
         m = dict(mesh.shape).get("mask", 1)
-    if chunk_size < m:
-        m = 1  # bound too tight to quantize; the fill's XLA fallback applies
-    quantum = (chunk_size // m) * m              # largest multiple of m <= bound
-    n_chunks = -(-n // quantum) if n else 0
-    if n_chunks:
-        chunk_size = m * -(-n // (m * n_chunks))
+    n_chunks, chunk_size = plan_chunks(n, chunk_size, m)
     pad = n_chunks * chunk_size - n
     rects_p = jnp.concatenate(
         [jnp.asarray(rects, jnp.int32),

@@ -45,7 +45,8 @@ def test_empty_bench_mode_means_attack_default(monkeypatch, capsys):
     stubbed out, so it reaches the could-not-run path) instead of emitting
     the unknown-mode error."""
     monkeypatch.setenv("BENCH_MODE", "")
-    monkeypatch.setattr(bench, "run_child", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "run_child",
+                        lambda *a, **k: (None, "timeout"))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error"] == "benchmark could not run"  # not the mode error
@@ -80,7 +81,8 @@ def test_unknown_bench_remat_policy_yields_error_json(monkeypatch, capsys):
     assert "BENCH_REMAT_POLICY" in rec["error"] and rec["value"] == 0.0
 
     monkeypatch.setenv("BENCH_REMAT_POLICY", "")
-    monkeypatch.setattr(bench, "run_child", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "run_child",
+                        lambda *a, **k: (None, "timeout"))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error"] == "benchmark could not run"
@@ -98,7 +100,34 @@ def test_unknown_bench_gn_yields_error_json(monkeypatch, capsys):
     assert "BENCH_GN" in rec["error"] and rec["value"] == 0.0
 
     monkeypatch.setenv("BENCH_GN", "")
-    monkeypatch.setattr(bench, "run_child", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "run_child",
+                        lambda *a, **k: (None, "timeout"))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error"] == "benchmark could not run"  # not the GN error
+
+
+def test_gn_crash_retries_flax_and_tags_row(monkeypatch, capsys):
+    """A crashed BENCH_GN=auto attack child triggers exactly one retry with
+    the flax GN; the successful row is tagged gn_fallback. A timeout (wedged
+    accelerator) must NOT trigger the retry (see the could-not-run tests)."""
+    for var in ("BENCH_MODE", "BENCH_GN", "BENCH_REMAT_POLICY", "BENCH_EOT",
+                "BENCH_IMG", "BENCH_ARCH"):
+        monkeypatch.delenv(var, raising=False)
+    calls = []
+
+    def stub(role, timeout_s, env_extra):
+        calls.append((role, dict(env_extra)))
+        if role == "torch":
+            return {"ips": 1.0}, None
+        if env_extra.get("BENCH_GN") == "flax":
+            return {"ips": 50.0, "batch": 8}, None
+        return None, "crash"
+
+    monkeypatch.setattr(bench, "run_child", stub)
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["gn_fallback"] == "flax"
+    assert rec["value"] == 50.0 and rec["vs_baseline"] == 50.0
+    jax_calls = [c for c in calls if c[0] == "jax"]
+    assert len(jax_calls) == 2 and jax_calls[1][1]["BENCH_GN"] == "flax"

@@ -150,6 +150,31 @@ def test_masked_predictions_mesh_chunk_rounding():
     np.testing.assert_array_equal(got, want)
 
 
+def test_plan_chunks_invariants_randomized():
+    """Property test of the chunk planner: coverage, the hard chunk_size
+    bound, mesh divisibility whenever the bound admits it, and minimal
+    padding (never worse than one quantum per chunk)."""
+    from dorpatch_tpu.defense import plan_chunks
+
+    rng = np.random.RandomState(7)
+    cases = [(0, 8, 1), (666, 128, 1), (666, 128, 4), (2520, 128, 8),
+             (8, 1, 4), (199, 100, 64), (1, 1, 1), (36, 7, 2)]
+    cases += [(int(rng.randint(0, 4000)), int(rng.randint(1, 512)),
+               int(rng.choice([1, 2, 4, 8, 64]))) for _ in range(300)]
+    for n, cs, m in cases:
+        n_chunks, chunk = plan_chunks(n, cs, m)
+        assert chunk <= cs, (n, cs, m)                       # memory bound
+        assert n_chunks * chunk >= n, (n, cs, m)             # coverage
+        if n == 0:
+            assert n_chunks == 0
+            continue
+        if cs >= m:
+            assert chunk % m == 0, (n, cs, m)                # mesh fast path
+        pad = n_chunks * chunk - n
+        quantum = m if cs >= m else 1
+        assert pad < quantum * n_chunks, (n, cs, m)          # near-minimal pad
+
+
 # ---------- stub-model end-to-end ----------
 
 @pytest.fixture(scope="module")

@@ -161,6 +161,43 @@ def test_forward_and_grad_match_torch_oracle():
     np.testing.assert_allclose(np.asarray(got_gx), want_gx, atol=1e-4)
 
 
+def test_attack_step_composition_with_remat_policies():
+    """The production composition: the kernel's custom VJP under jax.grad,
+    inside the attack's jitted scan block, wrapped by jax.checkpoint with
+    each remat policy. Asserts the block runs and the stepped state matches
+    the flax-GN victim's (same params, same rng)."""
+    from dorpatch_tpu import losses
+    from dorpatch_tpu import masks as masks_lib
+    from dorpatch_tpu.attack import DorPatch
+    from dorpatch_tpu.config import AttackConfig
+    from dorpatch_tpu.models.resnetv2 import ResNetV2
+
+    img, b = 16, 1
+    x = jax.random.uniform(jax.random.PRNGKey(0), (b, img, img, 3))
+    flax_model = ResNetV2(num_classes=4, layers=(1,), gn_impl="flax")
+    params = flax_model.init(jax.random.PRNGKey(1), x)
+    fused_model = ResNetV2(num_classes=4, layers=(1,), gn_impl="interpret")
+
+    universe = jnp.asarray(masks_lib.dropout_universe(img, 1, [0.12]))
+    y = jnp.zeros((b,), jnp.int32)
+    lv = jnp.mean(losses.local_variance(x)[0], axis=-1)
+
+    patterns = []
+    for model, policy in ((flax_model, "full"), (fused_model, "full"),
+                          (fused_model, "conv")):
+        cfg = AttackConfig(sampling_size=2, max_iterations=2, remat="on",
+                           remat_policy=policy)
+        apply_fn = lambda p, xx, m=model: m.apply(params, xx)
+        atk = DorPatch(apply_fn, None, 4, cfg)  # remat=None: follow cfg
+        state = atk._init_state(jax.random.PRNGKey(2), x, y, False,
+                                universe.shape[0])
+        block = atk._get_block(0, img, 2)
+        out = block(state, x, lv, universe)
+        patterns.append(np.asarray(out.adv_pattern, np.float32))
+    np.testing.assert_allclose(patterns[1], patterns[0], atol=2e-2)
+    np.testing.assert_allclose(patterns[2], patterns[1], atol=2e-2)
+
+
 def test_invalid_args():
     x = jnp.zeros((1, 2, 2, 48))
     with pytest.raises(ValueError):
