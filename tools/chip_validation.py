@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""One-shot on-chip validation sequence for the round-3 performance work.
+
+Runs, in order, each as an isolated child process (one JAX process at a
+time — the tunnel's device grant is exclusive):
+
+  1. fused-GN microbench           tools/profile_gn.py --variants gn,fused
+  2. attack bench, auto GN         python bench.py            (fused kernel)
+  3. attack bench, flax GN         BENCH_GN=flax python bench.py   (A/B)
+  4. certification bench           BENCH_MODE=certify python bench.py
+  5. EOT=128 remat, full policy    BENCH_REMAT=1 BENCH_REMAT_POLICY=full
+  6. EOT=128 remat, conv policy    BENCH_REMAT=1 BENCH_REMAT_POLICY=conv
+
+Results land in artifacts/chip_validation_r03.json as they complete, so a
+tunnel outage mid-sequence loses nothing. Usage:
+
+  python tools/chip_validation.py [--only 1,2,...] [--out PATH]
+
+Every step has a hard deadline; a wedged step is recorded and skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(cmd, env_extra, timeout_s):
+    # strip ambient BENCH_* so stray operator exports cannot silently turn
+    # an A/B step into two identical configs; each step pins what it needs
+    env = {k: v for k, v in os.environ.items() if not k.startswith("BENCH_")}
+    env.update(env_extra)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, env=env, cwd=ROOT, capture_output=True, text=True,
+            timeout=timeout_s)
+        return {"rc": proc.returncode, "seconds": round(time.time() - t0, 1),
+                "stdout": proc.stdout[-4000:], "stderr": proc.stderr[-4000:]}
+    except subprocess.TimeoutExpired as e:
+        def _txt(b):
+            if b is None:
+                return ""
+            return (b.decode(errors="replace") if isinstance(b, bytes)
+                    else b)[-4000:]
+        # keep whatever the child printed before the deadline: it is the
+        # only way to tell "hung claiming the device" from "hung in compile"
+        return {"rc": None, "seconds": round(time.time() - t0, 1),
+                "stdout": _txt(e.stdout), "stderr": _txt(e.stderr),
+                "error": f"timeout after {timeout_s}s"}
+
+
+def parse_bench(res):
+    if res.get("rc") == 0:
+        for line in reversed(res.get("stdout", "").splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except Exception:
+                    pass
+    return None
+
+
+def parse_profile_gn(res):
+    if res.get("rc") != 0:
+        return None  # partial rows from a crashed child are not a success
+    rows = {}
+    for line in res.get("stdout", "").splitlines():
+        m = re.match(r"\[(\w+)\] (fwd-only|fwd\+bwd) scan\s+([\d.]+) ms/iter",
+                     line)
+        if m:
+            rows[f"{m.group(1)}_{m.group(2).replace('+', '_')}"] = float(
+                m.group(3))
+    return rows or None
+
+
+STEPS = {
+    "1_gn_microbench": lambda t: (
+        parse_profile_gn,
+        run([sys.executable, "tools/profile_gn.py", "--variants", "gn,fused"],
+            {}, t)),
+    "2_attack_auto_gn": lambda t: (
+        parse_bench, run([sys.executable, "bench.py"], {}, t)),
+    "3_attack_flax_gn": lambda t: (
+        parse_bench, run([sys.executable, "bench.py"], {"BENCH_GN": "flax"}, t)),
+    "4_certify": lambda t: (
+        parse_bench,
+        run([sys.executable, "bench.py"], {"BENCH_MODE": "certify"}, t)),
+    "5_eot128_remat_full": lambda t: (
+        parse_bench,
+        run([sys.executable, "bench.py"],
+            {"BENCH_EOT": "128", "BENCH_BATCH": "4", "BENCH_REMAT": "1",
+             "BENCH_REMAT_POLICY": "full"}, t)),
+    "6_eot128_remat_conv": lambda t: (
+        parse_bench,
+        run([sys.executable, "bench.py"],
+            {"BENCH_EOT": "128", "BENCH_BATCH": "4", "BENCH_REMAT": "1",
+             "BENCH_REMAT_POLICY": "conv"}, t)),
+}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--only", default="",
+                   help="comma list of step prefixes (e.g. 1,2)")
+    p.add_argument("--out",
+                   default=os.path.join(ROOT, "artifacts",
+                                        "chip_validation_r03.json"))
+    p.add_argument("--timeout", type=int, default=2700,
+                   help="per-step deadline (Mosaic compiles through the "
+                        "tunnel can take many minutes)")
+    args = p.parse_args()
+    only = set(args.only.split(",")) if args.only else None
+
+    results = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                results = json.load(f)
+        except Exception:
+            print(f"warning: could not parse existing {args.out}; starting fresh",
+                  flush=True)
+
+    for name, step in STEPS.items():
+        if only is not None and name.split("_")[0] not in only:
+            continue
+        print(f"== {name}", flush=True)
+        parse, res = step(args.timeout)
+        parsed = parse(res)
+        results[name] = {"parsed": parsed,
+                         "rc": res.get("rc"),
+                         "seconds": res.get("seconds"),
+                         "error": res.get("error")}
+        if parsed is None:
+            results[name]["stdout_tail"] = res.get("stdout", "")[-1500:]
+            results[name]["stderr_tail"] = res.get("stderr", "")[-1500:]
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(results, f, indent=1)
+        os.replace(tmp, args.out)  # atomic: an interrupt never truncates
+        print(json.dumps({name: results[name].get("parsed")}), flush=True)
+
+    print(f"results -> {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
