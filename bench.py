@@ -43,7 +43,10 @@ ResNetV2 victims: "auto" = fused Pallas kernel on single-chip TPU, "flax" =
 XLA path — see ops/fused_gn.py), BENCH_PEAK_TFLOPS, BENCH_JAX_TIMEOUT (seconds, default 1800 —
 first-time Mosaic kernel compiles through the remote tunnel can add many
 minutes),
-BENCH_TORCH_TIMEOUT (default 600).
+BENCH_TORCH_TIMEOUT (default 600), BENCH_TOTAL_BUDGET (seconds, default
+3000 — a hard wall budget across ALL children; every child's timeout is
+clipped so the orchestrator always prints its JSON line before an outer
+driver timeout can kill it; see `_Deadline`).
 """
 
 from __future__ import annotations
@@ -329,11 +332,81 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
 # ------------------------------------------------------------ orchestrator
 
 
+class _Deadline:
+    """Hard wall budget across all child processes.
+
+    Round-3 failure mode (BENCH_r03.json): the jax child died fast on a
+    dead tunnel, the retry + CPU-fallback children were still queued behind
+    full default timeouts (2x1800s + 600s) when the *driver's* budget
+    expired -> rc=124 and no JSON line at all. Every child timeout is now
+    sliced from one shared budget, with reservations for the children that
+    must still run afterwards, so the orchestrator always reaches its
+    print() before any sane outer timeout."""
+
+    def __init__(self, total_s: float, clock=time.monotonic):
+        self._clock = clock
+        self._deadline = clock() + total_s
+
+    def remaining(self) -> float:
+        return max(0.0, self._deadline - self._clock())
+
+    def slice(self, want_s: float, reserve_s: float = 0.0) -> int:
+        """Timeout for the next child: at most `want_s`, leaving at least
+        `reserve_s` for children that must still run. 0 = don't spawn."""
+        return int(max(0.0, min(want_s, self.remaining() - reserve_s)))
+
+
+# Child-stderr signatures. Backend-init failures (dead/unreachable
+# accelerator tunnel) make ANY accelerator retry pointless -- skip straight
+# to the CPU fallback. Kernel signatures justify exactly one retry with the
+# always-partitionable flax GN (ADVICE r03: don't burn a full extra timeout
+# re-running an unrelated crash, e.g. HBM OOM or a dataset error).
+_BACKEND_INIT_SIGNATURES = (
+    "unable to initialize backend",
+    "unavailable:",
+    "failed to connect",
+    "no tpu devices",
+    "backend unavailable",
+)
+# NB deliberately no bare "memory space": XLA's HBM-OOM text ("Ran out of
+# memory in memory space hbm") is NOT a kernel failure — the flax-GN retry
+# would hit the same OOM. VMEM exhaustion ("memory space vmem") still
+# matches via "vmem". Crash-type signal deaths (segfault/abort/illegal
+# instruction — how a miscompiled kernel dies, leaving no traceback) are
+# kernel-suspect via the marker run_child appends; SIGKILL is NOT listed
+# (host OOM-killer — a retry would meet the same fate).
+_KERNEL_SIGNATURES = ("mosaic", "pallas", "vmem",
+                      "terminated by signal 11]",   # SIGSEGV
+                      "terminated by signal 6]",    # SIGABRT
+                      "terminated by signal 4]",    # SIGILL
+                      "terminated by signal 7]")    # SIGBUS
+
+
+def classify_failure(why: str, err_tail: str) -> str:
+    """-> 'timeout' | 'backend-init' | 'kernel' | 'other'.
+
+    'timeout': accelerator wedged; no software path can help.
+    'backend-init': the jax runtime never came up (dead tunnel; the
+      UNAVAILABLE / Unable-to-initialize text is in the child tail).
+    'kernel': Mosaic/Pallas/VMEM signature -- a GN-kernel regression the
+      flax-GN retry exists for.
+    'other': unrelated child crash; retrying the same accelerator path
+      with a different GN impl would meet the same fate."""
+    if why == "timeout":
+        return "timeout"
+    tail = (err_tail or "").lower()
+    if any(s in tail for s in _BACKEND_INIT_SIGNATURES):
+        return "backend-init"
+    if any(s in tail for s in _KERNEL_SIGNATURES):
+        return "kernel"
+    return "other"
+
+
 def run_child(role: str, timeout_s: int, env_extra: dict):
-    """-> (parsed JSON dict | None, reason). reason is None on success,
-    else "timeout" (accelerator wedged -- retrying a different software
-    path cannot help) vs "crash"/"no-json" (child-side failure -- a
-    different code path may succeed)."""
+    """-> (parsed JSON dict | None, reason, stderr_tail). reason is None on
+    success, else "timeout" (accelerator wedged -- retrying a different
+    software path cannot help) vs "crash"/"no-json" (child-side failure --
+    see classify_failure for what the stderr tail distinguishes)."""
     env = dict(os.environ)
     env["BENCH_ROLE"] = role
     env.update(env_extra)
@@ -355,21 +428,27 @@ def run_child(role: str, timeout_s: int, env_extra: dict):
         except (ProcessLookupError, PermissionError):
             proc.kill()
         try:
-            proc.communicate(timeout=10)
+            _, err = proc.communicate(timeout=10)
         except subprocess.TimeoutExpired:
-            pass
-        return None, "timeout"
+            err = ""
+        return None, "timeout", (err or "")[-4000:]
     for line in err.splitlines():
         if "WARNING" not in line:
             log(f"[{role}] {line}")
     if proc.returncode != 0:
         log(f"{role} child failed (rc={proc.returncode})")
-        return None, "crash"
+        tail = err[-4000:]
+        if proc.returncode < 0:
+            # signal deaths leave no traceback: record the signal so
+            # classify_failure can treat crash-type signals (a miscompiled
+            # kernel segfaulting) as kernel-suspect
+            tail += f"\n[child terminated by signal {-proc.returncode}]"
+        return None, "crash", tail
     try:
-        return json.loads(out.strip().splitlines()[-1]), None
+        return json.loads(out.strip().splitlines()[-1]), None, err[-4000:]
     except Exception:
         log(f"{role} child produced no JSON: {out[-300:]!r}")
-        return None, "no-json"
+        return None, "no-json", err[-4000:]
 
 
 def no_axon_env() -> dict:
@@ -415,39 +494,62 @@ def main() -> None:
     arch = os.environ.get("BENCH_ARCH", "resnetv2")
     img = int(os.environ.get("BENCH_IMG", "224"))
 
+    # One shared wall budget across every child (see _Deadline). Reserves
+    # guarantee the later, cheaper children still get a slot even when an
+    # earlier child eats its whole slice: the CPU fallback needs compile +
+    # a few steps of the small victim (~2-3 min observed), the torch
+    # baseline a model build + 3 steps.
+    budget = _Deadline(float(os.environ.get("BENCH_TOTAL_BUDGET", "3000")))
+    cpu_reserve, torch_reserve = 480, 180
+    floor = 20  # below this a child can't even finish imports: don't spawn
+
+    def spawn(role, want_s, reserve_s, env_extra):
+        t = budget.slice(want_s, reserve_s)
+        if t < floor:
+            log(f"skipping {role} child: {t}s left of BENCH_TOTAL_BUDGET "
+                f"after {reserve_s}s reserve")
+            return None, "budget", ""
+        return run_child(role, t, env_extra)
+
     fallback = None
     gn_fallback = None
-    res, why = run_child("jax", jax_timeout, {})
+    res, why, tail = spawn("jax", jax_timeout,
+                           cpu_reserve + torch_reserve, {})
+    failure = None if res is not None else classify_failure(why, tail)
     if (res is None and gn == "auto" and arch == "resnetv2"
-            and why in ("crash", "no-json")):
+            and failure == "kernel"):
         # The auto path selects the fused Pallas GN kernel on single-chip
-        # TPU backends; if that child *crashed* (e.g. a Mosaic lowering
-        # quirk on this chip generation), fall back to the always-
-        # partitionable flax GN before abandoning the accelerator — the
-        # proven XLA path must not be lost to a kernel regression. A
-        # timeout means the accelerator is wedged: skip straight to the
-        # CPU fallback instead of burning a second jax_timeout.
-        log("jax child crashed with BENCH_GN=auto; retrying with flax GN")
-        res, _ = run_child("jax", jax_timeout, {"BENCH_GN": "flax"})
+        # TPU backends; a crash with a Mosaic/Pallas/VMEM signature in the
+        # child tail means a kernel regression on this chip generation —
+        # fall back to the always-partitionable flax GN before abandoning
+        # the accelerator. Any other failure skips this retry: a timeout
+        # means the accelerator is wedged, a backend-init failure (dead
+        # tunnel, the r03 outage) means no accelerator child can succeed,
+        # and an unrelated crash would meet the same fate again.
+        log("jax child hit a kernel-signature crash with BENCH_GN=auto; "
+            "retrying with flax GN")
+        res, _, _ = spawn("jax", jax_timeout,
+                          cpu_reserve + torch_reserve, {"BENCH_GN": "flax"})
         if res is not None:
             gn_fallback = "flax"
     if res is None:
         # Accelerator unreachable/wedged: CPU + small victim, so the driver
         # still gets a self-consistent (same-model) ratio row.
+        log(f"accelerator path abandoned ({failure}); CPU fallback")
         fallback = {"BENCH_DATASET": "cifar10", "BENCH_ARCH": "resnet18",
                     "BENCH_IMG": "32", "BENCH_BATCH": "2",
                     # XLA-CPU emulates bf16 (slower than f32): keep the
                     # fallback row honest
                     "BENCH_DTYPE": "float32", **no_axon_env()}
         arch, img = "resnet18", 32
-        res, _ = run_child("jax", jax_timeout, fallback)
+        res, _, _ = spawn("jax", jax_timeout, torch_reserve, fallback)
     if res is None:
         print(json.dumps({"metric": err_metric, "value": 0.0,
                           "unit": "images/sec", "vs_baseline": 0.0,
                           "error": "benchmark could not run"}))
         return
 
-    tres, _ = run_child("torch", torch_timeout, fallback or {})
+    tres, _, _ = spawn("torch", torch_timeout, 0, fallback or {})
     torch_ips = tres["ips"] if tres else None
     log(f"jax: {res['ips']:.3f} images/sec; torch baseline: {torch_ips}")
 

@@ -46,7 +46,7 @@ def test_empty_bench_mode_means_attack_default(monkeypatch, capsys):
     the unknown-mode error."""
     monkeypatch.setenv("BENCH_MODE", "")
     monkeypatch.setattr(bench, "run_child",
-                        lambda *a, **k: (None, "timeout"))
+                        lambda *a, **k: (None, "timeout", ""))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error"] == "benchmark could not run"  # not the mode error
@@ -82,7 +82,7 @@ def test_unknown_bench_remat_policy_yields_error_json(monkeypatch, capsys):
 
     monkeypatch.setenv("BENCH_REMAT_POLICY", "")
     monkeypatch.setattr(bench, "run_child",
-                        lambda *a, **k: (None, "timeout"))
+                        lambda *a, **k: (None, "timeout", ""))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error"] == "benchmark could not run"
@@ -101,28 +101,29 @@ def test_unknown_bench_gn_yields_error_json(monkeypatch, capsys):
 
     monkeypatch.setenv("BENCH_GN", "")
     monkeypatch.setattr(bench, "run_child",
-                        lambda *a, **k: (None, "timeout"))
+                        lambda *a, **k: (None, "timeout", ""))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["error"] == "benchmark could not run"  # not the GN error
 
 
 def test_gn_crash_retries_flax_and_tags_row(monkeypatch, capsys):
-    """A crashed BENCH_GN=auto attack child triggers exactly one retry with
-    the flax GN; the successful row is tagged gn_fallback. A timeout (wedged
-    accelerator) must NOT trigger the retry (see the could-not-run tests)."""
+    """A BENCH_GN=auto attack child crashing with a Mosaic/Pallas signature
+    in its stderr tail triggers exactly one retry with the flax GN; the
+    successful row is tagged gn_fallback. A timeout (wedged accelerator)
+    must NOT trigger the retry (see the could-not-run tests)."""
     for var in ("BENCH_MODE", "BENCH_GN", "BENCH_REMAT_POLICY", "BENCH_EOT",
-                "BENCH_IMG", "BENCH_ARCH"):
+                "BENCH_IMG", "BENCH_ARCH", "BENCH_TOTAL_BUDGET"):
         monkeypatch.delenv(var, raising=False)
     calls = []
 
     def stub(role, timeout_s, env_extra):
         calls.append((role, dict(env_extra)))
         if role == "torch":
-            return {"ips": 1.0}, None
+            return {"ips": 1.0}, None, ""
         if env_extra.get("BENCH_GN") == "flax":
-            return {"ips": 50.0, "batch": 8}, None
-        return None, "crash"
+            return {"ips": 50.0, "batch": 8}, None, ""
+        return None, "crash", "INTERNAL: Mosaic failed to compile kernel"
 
     monkeypatch.setattr(bench, "run_child", stub)
     bench.main()
@@ -131,3 +132,129 @@ def test_gn_crash_retries_flax_and_tags_row(monkeypatch, capsys):
     assert rec["value"] == 50.0 and rec["vs_baseline"] == 50.0
     jax_calls = [c for c in calls if c[0] == "jax"]
     assert len(jax_calls) == 2 and jax_calls[1][1]["BENCH_GN"] == "flax"
+
+
+# --------------------------------------------- r04: outage-proofing (VERDICT
+# round-3 weak #1: a dead-tunnel child was classified as a kernel crash and
+# the flax retry burned the driver's whole budget before the CPU fallback)
+
+
+def test_classify_failure():
+    assert bench.classify_failure("timeout", "anything") == "timeout"
+    assert bench.classify_failure(
+        "crash", "jaxlib...: UNAVAILABLE: failed to connect to all "
+        "addresses") == "backend-init"
+    assert bench.classify_failure(
+        "crash", "RuntimeError: Unable to initialize backend 'axon'"
+    ) == "backend-init"
+    assert bench.classify_failure(
+        "crash", "INTERNAL: Mosaic lowering failed") == "kernel"
+    assert bench.classify_failure(
+        "crash", "pallas_call: ... exceeds available VMEM") == "kernel"
+    assert bench.classify_failure(
+        "crash", "FileNotFoundError: no dataset") == "other"
+    # an HBM OOM is NOT a kernel failure: the flax-GN retry would meet the
+    # same fate, so it must go straight to the CPU fallback
+    assert bench.classify_failure(
+        "crash", "RESOURCE_EXHAUSTED: Ran out of memory in memory space hbm"
+    ) == "other"
+    assert bench.classify_failure(
+        "crash", "Mosaic: exceeded VMEM in memory space vmem") == "kernel"
+    assert bench.classify_failure("no-json", "") == "other"
+
+
+def test_backend_unavailable_skips_retry_goes_to_cpu(monkeypatch, capsys):
+    """The r03 outage transcript, replayed: the jax child dies fast with an
+    UNAVAILABLE tail. The orchestrator must NOT re-try the accelerator with
+    flax GN (useless against a dead backend) — the very next jax child must
+    be the CPU fallback, and the row must carry fallback=cpu."""
+    for var in ("BENCH_MODE", "BENCH_GN", "BENCH_REMAT_POLICY", "BENCH_EOT",
+                "BENCH_IMG", "BENCH_ARCH", "BENCH_TOTAL_BUDGET"):
+        monkeypatch.delenv(var, raising=False)
+    calls = []
+
+    def stub(role, timeout_s, env_extra):
+        calls.append((role, dict(env_extra)))
+        if role == "torch":
+            return {"ips": 0.5}, None, ""
+        if env_extra.get("JAX_PLATFORMS") == "cpu":  # the CPU fallback
+            return {"ips": 4.0, "batch": 2}, None, ""
+        return None, "crash", ("E0000 ... UNAVAILABLE: failed to connect\n"
+                               "RuntimeError: Unable to initialize backend "
+                               "'axon'")
+
+    monkeypatch.setattr(bench, "run_child", stub)
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["fallback"] == "cpu" and rec["value"] == 4.0
+    jax_calls = [c for c in calls if c[0] == "jax"]
+    # exactly one accelerator generation + one CPU generation, no flax retry
+    assert len(jax_calls) == 2
+    assert "BENCH_GN" not in jax_calls[1][1]
+    assert jax_calls[1][1]["JAX_PLATFORMS"] == "cpu"
+
+
+def test_deadline_slices_and_reserves():
+    t = [0.0]
+    d = bench._Deadline(1000, clock=lambda: t[0])
+    assert d.slice(1800, 660) == 340  # clipped by budget - reserve
+    assert d.slice(300, 660) == 300   # own timeout smaller than the slice
+    t[0] = 990.0
+    assert d.slice(1800, 0) == 10
+    assert d.slice(1800, 660) == 0    # nothing left after the reserve
+    t[0] = 2000.0
+    assert d.remaining() == 0.0
+
+
+def test_total_budget_clips_child_timeouts(monkeypatch, capsys):
+    """With BENCH_TOTAL_BUDGET set, no child may be spawned with a timeout
+    that could push the orchestrator past the budget: the first child's
+    slice is budget minus the fallback+torch reserves."""
+    for var in ("BENCH_MODE", "BENCH_GN", "BENCH_REMAT_POLICY", "BENCH_EOT",
+                "BENCH_IMG", "BENCH_ARCH"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET", "1000")
+    seen = []
+
+    def stub(role, timeout_s, env_extra):
+        seen.append((role, timeout_s))
+        if role == "torch":
+            return {"ips": 1.0}, None, ""
+        return {"ips": 10.0, "batch": 8}, None, ""
+
+    monkeypatch.setattr(bench, "run_child", stub)
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 10.0
+    assert seen[0][0] == "jax" and seen[0][1] <= 1000 - 660
+    assert seen[1][0] == "torch" and seen[1][1] <= 600
+
+
+def test_exhausted_budget_still_prints_json(monkeypatch, capsys):
+    """Even a budget too small to spawn ANY child must yield the error JSON
+    line immediately — the driver always gets its row (r03's rc=124 was
+    exactly this guarantee failing)."""
+    for var in ("BENCH_MODE", "BENCH_GN", "BENCH_REMAT_POLICY", "BENCH_EOT",
+                "BENCH_IMG", "BENCH_ARCH"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET", "5")
+    spawned = []
+    monkeypatch.setattr(
+        bench, "run_child",
+        lambda *a, **k: spawned.append(a) or (None, "timeout", ""))
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["error"] == "benchmark could not run" and rec["value"] == 0.0
+    assert not spawned  # nothing was allowed to claim the (dead) device
+
+
+def test_signal_death_is_kernel_suspect():
+    """A miscompiled kernel dies by SIGSEGV/SIGABRT with no traceback:
+    run_child appends a signal marker and classify_failure treats it as
+    kernel-suspect (one flax retry). SIGKILL (host OOM-killer) is NOT."""
+    assert bench.classify_failure(
+        "crash", "...\n[child terminated by signal 11]") == "kernel"
+    assert bench.classify_failure(
+        "crash", "[child terminated by signal 6]") == "kernel"
+    assert bench.classify_failure(
+        "crash", "[child terminated by signal 9]") == "other"
