@@ -98,7 +98,7 @@ class GroupNormRelu(nn.Module):
 
         impl = self.impl
         if impl == "auto":
-            impl = "pallas" if fused_gn.auto_pallas(x.shape) else "flax"
+            impl = "pallas" if fused_gn.auto_pallas(x.shape, x.dtype) else "flax"
         if impl == "flax":
             dt = x.dtype
             x = nn.GroupNorm(

@@ -24,8 +24,14 @@ Group statistics never touch HBM mid-kernel: per-channel sums are reduced to
 per-group sums with a tiny `[C, G]` one-hot matmul (MXU-friendly; lane axis
 stays C), and broadcast back with its transpose.
 
-The grid is one program per sample; the largest slab (56x56x256 f32 in
-stage 0) is ~3.2 MB — comfortably VMEM-resident with double buffering.
+The forward grid is one program per sample (whole [HW, C] slab in VMEM);
+the backward additionally has an HW-tiled two-pass variant
+(`_pallas_bwd_tiled`: tiled stats accumulation, then tiled dx) for slabs
+whose untiled live set busts the VMEM budget — admission is decided
+per-shape and per-dtype by `auto_pallas`/`_bwd_plan` (conservative
+double-buffered estimates; e.g. the largest RN50 slab, 56x56x256, is
+tiled at bf16 and routed to XLA at f32, whose whole-slab *forward*
+already exceeds the budget).
 
 `gn_relu` dispatches like `ops.masked_fill`: "auto" uses Pallas on a
 single-device TPU backend and the jnp reference elsewhere. Under a
@@ -150,9 +156,126 @@ def _pallas_fwd(x, scale, bias, g: int, eps: float, interpret: bool):
     return y.reshape(n, h, w, c), mean, rstd
 
 
+def _bwd_stats_kernel(g: int, x_ref, dy_ref, s_ref, b_ref, mean_ref, rstd_ref,
+                      ag_ref, bg_ref, ds_ref, db_ref):
+    """Tiled phase 1: per-(sample, group) sums over HW tiles.
+
+    Grid (n, T); the four outputs' block index depends only on the sample, so
+    they stay VMEM-resident across a sample's tiles and accumulate (zeroed at
+    the sample's first tile). All sums are linear, so per-tile partial
+    contributions add exactly."""
+    t = pl.program_id(1)
+    xf = x_ref[0].astype(jnp.float32)                        # [HW/T, C]
+    c = xf.shape[1]
+    gm = _group_matrices(c, g)
+    mean_c = jnp.dot(mean_ref[0], gm.T, preferred_element_type=jnp.float32)
+    rstd_c = jnp.dot(rstd_ref[0], gm.T, preferred_element_type=jnp.float32)
+    xhat = (xf - mean_c) * rstd_c
+    gate = xhat * s_ref[...] + b_ref[...] > 0.0
+    dyr = jnp.where(gate, dy_ref[0].astype(jnp.float32), 0.0)
+    db_t = jnp.sum(dyr, axis=0, keepdims=True)               # [1, C]
+    ds_t = jnp.sum(dyr * xhat, axis=0, keepdims=True)
+
+    @pl.when(t == 0)
+    def _init():
+        ag_ref[0] = jnp.zeros_like(ag_ref[0])
+        bg_ref[0] = jnp.zeros_like(bg_ref[0])
+        ds_ref[0] = jnp.zeros_like(ds_ref[0])
+        db_ref[0] = jnp.zeros_like(db_ref[0])
+
+    ag_ref[0] += jnp.dot(db_t * s_ref[...], gm,
+                         preferred_element_type=jnp.float32)
+    bg_ref[0] += jnp.dot(ds_t * s_ref[...], gm,
+                         preferred_element_type=jnp.float32)
+    ds_ref[0] += ds_t
+    db_ref[0] += db_t
+
+
+def _bwd_dx_kernel(g: int, cnt: float, x_ref, dy_ref, s_ref, b_ref, mean_ref,
+                   rstd_ref, ag_ref, bg_ref, dx_ref):
+    """Tiled phase 2: dx per HW tile from the phase-1 group sums."""
+    xf = x_ref[0].astype(jnp.float32)
+    c = xf.shape[1]
+    gm = _group_matrices(c, g)
+    mean_c = jnp.dot(mean_ref[0], gm.T, preferred_element_type=jnp.float32)
+    rstd_c = jnp.dot(rstd_ref[0], gm.T, preferred_element_type=jnp.float32)
+    xhat = (xf - mean_c) * rstd_c
+    gate = xhat * s_ref[...] + b_ref[...] > 0.0
+    dyr = jnp.where(gate, dy_ref[0].astype(jnp.float32), 0.0)
+    a_c = jnp.dot(ag_ref[0], gm.T, preferred_element_type=jnp.float32)
+    b_c = jnp.dot(bg_ref[0], gm.T, preferred_element_type=jnp.float32)
+    dx = rstd_c * (dyr * s_ref[...] - (a_c + xhat * b_c) / cnt)
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+
+
+def _pallas_bwd_tiled(x, dy, scale, bias, mean, rstd, g: int, tiles: int,
+                      interpret: bool):
+    """Two-pass tiled backward: same math as `_bwd_kernel`, but x/dy/dx are
+    streamed in HW tiles so VMEM holds one tile (not the whole slab) per
+    step. Costs one extra read of x and dy vs the untiled kernel — the
+    price of admitting slabs whose untiled live set busts VMEM."""
+    n, h, w, c = x.shape
+    hw = h * w
+    th = hw // tiles
+    xr = x.reshape(n, hw, c)
+    dyr = dy.reshape(n, hw, c)
+    s2 = scale.astype(jnp.float32).reshape(1, c)
+    b2 = bias.astype(jnp.float32).reshape(1, c)
+
+    tile_specs = [
+        pl.BlockSpec((1, th, c), lambda i, t: (i, t, 0)),
+        pl.BlockSpec((1, th, c), lambda i, t: (i, t, 0)),
+        pl.BlockSpec((1, c), lambda i, t: (0, 0)),
+        pl.BlockSpec((1, c), lambda i, t: (0, 0)),
+        pl.BlockSpec((1, 1, g), lambda i, t: (i, 0, 0)),
+        pl.BlockSpec((1, 1, g), lambda i, t: (i, 0, 0)),
+    ]
+    per_sample = lambda i, t: (i, 0, 0)  # noqa: E731 - accumulator blocks
+    ag, bg, ds_p, db_p = pl.pallas_call(
+        functools.partial(_bwd_stats_kernel, g),
+        grid=(n, tiles),
+        in_specs=tile_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, g), per_sample),
+            pl.BlockSpec((1, 1, g), per_sample),
+            pl.BlockSpec((1, 1, c), per_sample),
+            pl.BlockSpec((1, 1, c), per_sample),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1, g), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, g), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, c), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xr, dyr, s2, b2, mean, rstd)
+
+    dx = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, g, float(hw * (c // g))),
+        grid=(n, tiles),
+        in_specs=tile_specs + [
+            pl.BlockSpec((1, 1, g), per_sample),
+            pl.BlockSpec((1, 1, g), per_sample),
+        ],
+        out_specs=pl.BlockSpec((1, th, c), lambda i, t: (i, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, hw, c), x.dtype),
+        interpret=interpret,
+    )(xr, dyr, s2, b2, mean, rstd, ag, bg)
+    return (dx.reshape(n, h, w, c),
+            jnp.sum(ds_p, axis=(0, 1)), jnp.sum(db_p, axis=(0, 1)))
+
+
 def _pallas_bwd(x, dy, scale, bias, mean, rstd, g: int, interpret: bool):
     n, h, w, c = x.shape
     hw = h * w
+    tiles = _bwd_plan(hw, c, jnp.dtype(x.dtype).itemsize)
+    if tiles is None:
+        raise ValueError(
+            f"no VMEM-feasible backward plan for slab ({hw},{c}) "
+            f"{x.dtype}; auto_pallas should have routed this to XLA")
+    if tiles > 1:
+        return _pallas_bwd_tiled(x, dy, scale, bias, mean, rstd, g, tiles,
+                                 interpret)
     dx, ds_p, db_p = pl.pallas_call(
         functools.partial(_bwd_kernel, g),
         grid=(n,),
@@ -204,19 +327,52 @@ def _vjp_bwd(g: int, eps: float, interpret: bool, res, dy):
 _gn_relu_pallas.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-# Largest per-sample [HW, C] slab the kernels take whole (no spatial
-# tiling): the backward holds a handful of f32 slab temporaries in VMEM
-# (~16 MB/core on v5e), so gate at 4 MB — admits every layer of the
-# 224-resolution victims (max slab 56*56*256*4 = 3.2 MB) and falls back to
-# the XLA path for larger image sizes instead of failing Mosaic compile.
-_MAX_SLAB_BYTES = 4 * 1024 * 1024
+# VMEM admission (ADVICE r03: budget ALL live backward operands, not one
+# slab). Estimates assume pallas_call's default double-buffered pipelining
+# on every streamed block and that Mosaic fuses none of the f32 in-kernel
+# slab temporaries (xf, dyr, xhat, and the pre-cast result) — conservative
+# by construction, since none of this has compiled on silicon yet. Budget
+# 14 MB of the ~16 MB/core (v5e), leaving headroom for the tiny
+# stats/affine blocks and kernel bookkeeping.
+_VMEM_BUDGET_BYTES = 14 * 1024 * 1024
+_MAX_BWD_TILES = 256
 
 
-def auto_pallas(x_shape=None) -> bool:
+def _fwd_vmem_bytes(slab_elems: int, itemsize: int) -> int:
+    """One `_fwd_kernel` grid step: x in + y out double-buffered, ~2 f32
+    slab temporaries (xf and the pre-ReLU result)."""
+    return 2 * 2 * slab_elems * itemsize + 2 * slab_elems * 4
+
+
+def _bwd_vmem_bytes(tile_elems: int, itemsize: int) -> int:
+    """One backward grid step over a [HW/T, C] tile (T=1 = the untiled
+    `_bwd_kernel`): x, dy in + dx out double-buffered, ~4 f32 tile
+    temporaries."""
+    return 2 * 3 * tile_elems * itemsize + 4 * tile_elems * 4
+
+
+def _bwd_plan(hw: int, c: int, itemsize: int):
+    """How to run the backward for a [HW, C] slab: 1 = whole-slab kernel,
+    T > 1 = `_pallas_bwd_tiled` with T HW-tiles, None = no feasible plan
+    (route to XLA). Tiles must divide HW on a Mosaic-aligned row boundary
+    (sublane multiple: 16 rows at bf16, 8 at f32)."""
+    if _bwd_vmem_bytes(hw * c, itemsize) <= _VMEM_BUDGET_BYTES:
+        return 1
+    align = 16 if itemsize == 2 else 8
+    for t in range(2, min(hw, _MAX_BWD_TILES) + 1):
+        if hw % t or (hw // t) % align:
+            continue
+        if _bwd_vmem_bytes((hw // t) * c, itemsize) <= _VMEM_BUDGET_BYTES:
+            return t
+    return None
+
+
+def auto_pallas(x_shape=None, x_dtype=None) -> bool:
     """Dispatch predicate for impl="auto": the Pallas kernel on a
-    single-device TPU backend (and, when `x_shape` [N,H,W,C] is given,
-    only when the per-sample slab fits the kernels' VMEM budget); the
-    GSPMD-partitionable path elsewhere."""
+    single-device TPU backend (and, when `x_shape` [N,H,W,C] is given, only
+    when the forward's whole-slab live set fits the VMEM budget AND a
+    feasible backward plan exists — dtype-aware, bf16 slabs stream at half
+    the f32 rate); the GSPMD-partitionable path elsewhere."""
     from dorpatch_tpu.ops._backend import is_tpu_backend
 
     try:
@@ -225,7 +381,9 @@ def auto_pallas(x_shape=None) -> bool:
         return False
     if ok and x_shape is not None:
         n, h, w, c = x_shape
-        ok = h * w * c * 4 <= _MAX_SLAB_BYTES
+        itemsize = jnp.dtype(x_dtype).itemsize if x_dtype is not None else 4
+        ok = (_fwd_vmem_bytes(h * w * c, itemsize) <= _VMEM_BUDGET_BYTES
+              and _bwd_plan(h * w, c, itemsize) is not None)
     return ok
 
 
@@ -243,7 +401,7 @@ def gn_relu(x: jax.Array, scale: jax.Array, bias: jax.Array,
     if x.shape[-1] % num_groups:
         raise ValueError(f"C={x.shape[-1]} not divisible by {num_groups} groups")
     if impl == "auto":
-        impl = "pallas" if auto_pallas(x.shape) else "jnp"
+        impl = "pallas" if auto_pallas(x.shape, x.dtype) else "jnp"
     if impl == "jnp":
         return gn_relu_reference(x, scale, bias, num_groups, eps)
     if impl not in ("pallas", "interpret"):
