@@ -172,10 +172,14 @@ class ViTTorch(nn.Module):
 
 
 class AffineTorch(nn.Module):
+    """timm mlp_mixer `Affine`: alpha/beta stored [1, 1, D] (the exact
+    shapes the PatchCleanser resmlp checkpoints carry — the converter
+    flattens them to the flax [D] params)."""
+
     def __init__(self, dim):
         super().__init__()
-        self.alpha = nn.Parameter(torch.ones(dim))
-        self.beta = nn.Parameter(torch.zeros(dim))
+        self.alpha = nn.Parameter(torch.ones(1, 1, dim))
+        self.beta = nn.Parameter(torch.zeros(1, 1, dim))
 
     def forward(self, x):
         return self.alpha * x + self.beta
@@ -200,19 +204,22 @@ class ResMLPBlockTorch(nn.Module):
 
 
 class ResMLPTorch(nn.Module):
-    """ResMLP-24, timm mlp_mixer-compatible keys."""
+    """ResMLP-24, timm mlp_mixer-compatible keys. NB the patch embed is
+    named `stem` — timm's `MlpMixer` naming (unlike `VisionTransformer`'s
+    `patch_embed`); r03 review caught the twin using the ViT name, which
+    would have KeyError'd on a real checkpoint."""
 
     def __init__(self, num_classes=1000, dim=384, depth=24, patch=16, img=224):
         super().__init__()
-        self.patch_embed = nn.Module()
-        self.patch_embed.proj = nn.Conv2d(3, dim, patch, patch)
+        self.stem = nn.Module()
+        self.stem.proj = nn.Conv2d(3, dim, patch, patch)
         seq_len = (img // patch) ** 2
         self.blocks = nn.ModuleList([ResMLPBlockTorch(dim, seq_len) for _ in range(depth)])
         self.norm = AffineTorch(dim)
         self.head = nn.Linear(dim, num_classes)
 
     def forward(self, x):
-        x = self.patch_embed.proj(x).flatten(2).transpose(1, 2)
+        x = self.stem.proj(x).flatten(2).transpose(1, 2)
         for blk in self.blocks:
             x = blk(x)
         return self.head(self.norm(x).mean(dim=1))

@@ -172,16 +172,63 @@ def convert_cifar_resnet18(
     return {"params": params}
 
 
+def export_cifar_resnet18(
+    params: Mapping, stage_sizes: Sequence[int] = (2, 2, 2, 2)
+) -> Dict[str, np.ndarray]:
+    """Inverse of `convert_cifar_resnet18`: flax params -> torch-twin
+    state_dict (HWIO->OIHW, Dense kernel transposed). Lets `train.py` export
+    a trained victim as a `.pth` the standard checkpoint path loads —
+    round-trip pinned by `tests/test_models.py`."""
+    p = params["params"] if "params" in params else params
+
+    def arr(a):
+        return np.asarray(a, dtype=np.float32)
+
+    def conv(kernel):
+        return arr(kernel).transpose(3, 2, 0, 1)
+
+    sd: Dict[str, np.ndarray] = {
+        "stem.weight": conv(p["stem"]["kernel"]),
+        "stem_norm.weight": arr(p["stem_norm"]["scale"]),
+        "stem_norm.bias": arr(p["stem_norm"]["bias"]),
+        "head.weight": arr(p["head"]["kernel"]).T,
+        "head.bias": arr(p["head"]["bias"]),
+    }
+    bi_flat = 0
+    for si, depth in enumerate(stage_sizes):
+        for bi in range(depth):
+            blk = p[f"stage{si}_block{bi}"]
+            dst = f"blocks.{bi_flat}."
+            sd[dst + "conv1.weight"] = conv(blk["conv1"]["kernel"])
+            sd[dst + "norm1.weight"] = arr(blk["norm1"]["scale"])
+            sd[dst + "norm1.bias"] = arr(blk["norm1"]["bias"])
+            sd[dst + "conv2.weight"] = conv(blk["conv2"]["kernel"])
+            sd[dst + "norm2.weight"] = arr(blk["norm2"]["scale"])
+            sd[dst + "norm2.bias"] = arr(blk["norm2"]["bias"])
+            if "proj" in blk:
+                sd[dst + "proj.0.weight"] = conv(blk["proj"]["kernel"])
+                sd[dst + "proj.1.weight"] = arr(blk["proj_norm"]["scale"])
+                sd[dst + "proj.1.bias"] = arr(blk["proj_norm"]["bias"])
+            bi_flat += 1
+    return sd
+
+
 def convert_resmlp(sd: Mapping[str, np.ndarray], depth: int = 24) -> Dict:
-    """Convert a timm `resmlp_24_distilled_224` state_dict to flax ResMLP params."""
+    """Convert a timm `resmlp_24_distilled_224` state_dict to flax ResMLP params.
+
+    timm `MlpMixer` naming (`timm_keys.py` fixture): the patch embed is
+    `stem.proj` (not ViT's `patch_embed.proj`), and `Affine` alpha/beta are
+    stored `[1, 1, D]` — flattened here to the flax `[D]` params.
+    """
 
     def affine(key):
-        return {"alpha": _np(sd[key + ".alpha"]), "beta": _np(sd[key + ".beta"])}
+        return {"alpha": _np(sd[key + ".alpha"]).reshape(-1),
+                "beta": _np(sd[key + ".beta"]).reshape(-1)}
 
     params: Dict = {
         "patch_embed": {
-            "kernel": _conv_kernel(sd["patch_embed.proj.weight"]),
-            "bias": _np(sd["patch_embed.proj.bias"]),
+            "kernel": _conv_kernel(sd["stem.proj.weight"]),
+            "bias": _np(sd["stem.proj.bias"]),
         },
         "norm": affine("norm"),
         "head": _dense(sd, "head"),

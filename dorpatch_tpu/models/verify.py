@@ -14,9 +14,17 @@ on a batch of seeded random images. A max delta within `--tol` (default
 1e-3 — GroupNorm/LayerNorm accumulate ~1e-4 noise in f32 at RN50 depth)
 exits 0; anything larger exits 1 and prints the per-image deltas.
 
+`--keys-only` skips the logit comparison and instead diffs the checkpoint's
+key/shape set against the vendored timm-0.6.7 contract
+(`models/timm_keys.py`): missing, unexpected, and shape-drifted keys are
+reported (exit 1 on any drift). This runs without building either model —
+a fast naming-contract check for checkpoints AND a drift alarm if a future
+timm re-pin renames modules.
+
 Usage:
   python -m dorpatch_tpu.models.verify path/to/resnetv2_50x1_bit_distilled_cutout2_128_imagenet.pth
   python -m dorpatch_tpu.models.verify ckpt.pth --arch vit --dataset imagenet
+  python -m dorpatch_tpu.models.verify ckpt.pth --keys-only
 """
 
 from __future__ import annotations
@@ -110,6 +118,24 @@ def jax_tree_leaves(tree):
     return jax.tree_util.tree_leaves(tree)
 
 
+def verify_keys(ckpt_path: str, arch: str, dataset: str) -> dict:
+    """Diff the checkpoint's keys/shapes against the vendored timm contract.
+
+    Returns {"arch", "n_keys", "missing", "unexpected", "shape_drift"};
+    clean iff the last three are empty."""
+    from dorpatch_tpu.config import NUM_CLASSES
+    from dorpatch_tpu.models import registry, timm_keys
+    from dorpatch_tpu.models.convert import load_state_dict
+
+    timm_name = registry.resolve_arch(arch)
+    sd = load_state_dict(ckpt_path)
+    report = timm_keys.diff_against_contract(
+        sd.keys(), timm_name, NUM_CLASSES[dataset],
+        sd_shapes={k: v.shape for k, v in sd.items()})
+    report.update(arch=timm_name, n_keys=len(sd))
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="Verify a timm/PatchCleanser checkpoint converts to flax "
@@ -125,11 +151,32 @@ def main(argv=None) -> int:
     p.add_argument("--img-size", type=int, default=224)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tol", type=float, default=1e-3)
+    p.add_argument("--keys-only", action="store_true",
+                   help="only diff the checkpoint's key/shape set against "
+                   "the vendored timm-0.6.7 contract (models/timm_keys.py)")
     args = p.parse_args(argv)
 
     if not os.path.exists(args.checkpoint):
         print(f"checkpoint not found: {args.checkpoint}", file=sys.stderr)
         return 2
+    if args.keys_only:
+        report = verify_keys(
+            args.checkpoint,
+            args.arch or _infer_arch(args.checkpoint),
+            args.dataset or _infer_dataset(args.checkpoint),
+        )
+        drift = (report["missing"] or report["unexpected"]
+                 or report["shape_drift"])
+        verdict = "FAIL" if drift else "OK"
+        print(f"[{verdict}] {report['arch']}: {report['n_keys']} keys vs "
+              f"vendored timm-0.6.7 contract — "
+              f"{len(report['missing'])} missing, "
+              f"{len(report['unexpected'])} unexpected, "
+              f"{len(report['shape_drift'])} shape-drifted")
+        for field in ("missing", "unexpected", "shape_drift"):
+            for item in report[field][:20]:
+                print(f"  {field}: {item}")
+        return 1 if drift else 0
     report = verify_checkpoint(
         args.checkpoint,
         args.arch or _infer_arch(args.checkpoint),

@@ -161,3 +161,102 @@ def test_cifar_resnet18_forward_and_grad():
     assert logits.shape == (2, 10)
     g = jax.grad(lambda x: m.apply(params, x).sum())(x)
     assert np.isfinite(np.asarray(g)).all()
+
+
+# ---------- vendored timm-0.6.7 key contract (models/timm_keys.py) ----------
+
+class _TrackingSD(dict):
+    """State-dict stub shaped exactly like the vendored contract, recording
+    which keys the converter reads (`in`-probes don't count as reads)."""
+
+    def __init__(self, contract):
+        super().__init__({k: np.zeros(s, np.float32)
+                          for k, s in contract.items()})
+        self.read = set()
+
+    def __getitem__(self, k):
+        self.read.add(k)
+        return super().__getitem__(k)
+
+
+_CONTRACT_CASES = [
+    ("resnetv2_50x1_bit_distilled", 10, 224),
+    ("vit_base_patch16_224", 10, 224),
+    ("resmlp_24_distilled_224", 10, 224),
+    ("cifar_resnet18", 10, 32),
+]
+
+
+@pytest.mark.parametrize("timm_name,n_classes,img", _CONTRACT_CASES)
+def test_converter_consumes_exact_timm_contract(timm_name, n_classes, img):
+    """Each converter must read EVERY key of the vendored timm-0.6.7
+    contract and NOTHING else — a timm naming drift (e.g. mlp_mixer's
+    `stem` vs ViT's `patch_embed`, caught r04) fails here instead of
+    KeyError-ing on the first real checkpoint."""
+    from dorpatch_tpu.models import registry, timm_keys
+
+    contract = timm_keys.state_dict_contract(timm_name, n_classes)
+    sd = _TrackingSD(contract)
+    registry._convert(timm_name, sd)
+    assert sd.read == set(contract), (
+        f"unread contract keys: {sorted(set(contract) - sd.read)[:5]}; "
+        f"reads outside contract would have KeyError'd")
+
+
+@pytest.mark.parametrize("timm_name,n_classes,img", _CONTRACT_CASES)
+def test_converted_contract_matches_flax_init_shapes(timm_name, n_classes, img):
+    """Converting a contract-shaped state_dict yields exactly the param
+    tree the flax model initializes (structure AND leaf shapes) — checked
+    via eval_shape, no weights materialized through the model."""
+    from dorpatch_tpu.models import registry, timm_keys
+
+    contract = timm_keys.state_dict_contract(timm_name, n_classes)
+    converted = registry._convert(
+        timm_name, {k: np.zeros(s, np.float32) for k, s in contract.items()})
+    model = registry._build_flax(timm_name, n_classes)
+    want = jax.tree_util.tree_map(
+        lambda x: x.shape,
+        jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                       jnp.zeros((1, img, img, 3), jnp.float32)))
+    got = jax.tree_util.tree_map(lambda x: np.asarray(x).shape, converted)
+    assert want == got
+
+
+def test_verify_keys_reports_drift(tmp_path):
+    """`models/verify.py --keys-only` flags missing/unexpected/shape-drifted
+    keys against the contract (the drift alarm for a future timm re-pin)."""
+    import torch as _torch
+
+    from dorpatch_tpu.models import timm_keys
+    from dorpatch_tpu.models.verify import verify_keys
+
+    contract = timm_keys.state_dict_contract("resmlp_24_distilled_224", 10)
+    sd = {k: _torch.zeros(s) for k, s in contract.items()}
+    del sd["stem.proj.bias"]                        # missing
+    sd["patch_embed.proj.weight"] = _torch.zeros(1)  # unexpected (old name)
+    sd["norm.alpha"] = _torch.zeros(384)             # shape drift
+    p = tmp_path / "resmlp_24_distilled_224_cutout2_128_cifar10.pth"
+    _torch.save({"state_dict": sd}, str(p))
+    report = verify_keys(str(p), "resmlp", "cifar10")
+    assert report["missing"] == ["stem.proj.bias"]
+    assert report["unexpected"] == ["patch_embed.proj.weight"]
+    assert len(report["shape_drift"]) == 1 and "norm.alpha" in report["shape_drift"][0]
+
+
+@pytest.mark.parametrize("arch,timm_name", [
+    ("resnetv2", "resnetv2_50x1_bit_distilled"),
+    ("vit", "vit_base_patch16_224"),
+    ("resmlp", "resmlp_24_distilled_224"),
+    ("resnet18", "cifar_resnet18"),
+])
+def test_torch_twin_state_dict_equals_contract(arch, timm_name):
+    """The torch twins must carry EXACTLY the vendored contract's keys and
+    shapes — twin==contract here, twin==flax in the parity tests, so
+    contract==flax transitively, with no real checkpoint needed."""
+    from dorpatch_tpu.models import timm_keys
+
+    tm = create_torch_model(arch, 10)
+    sd = {k: tuple(v.shape) for k, v in tm.state_dict().items()}
+    contract = {k: tuple(s)
+                for k, s in timm_keys.state_dict_contract(timm_name, 10).items()}
+    assert sd == contract
