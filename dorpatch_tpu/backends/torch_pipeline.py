@@ -26,6 +26,7 @@ from dorpatch_tpu.backends.torch_attack import (
 )
 from dorpatch_tpu.backends.torch_models import Normalized, create_torch_model
 from dorpatch_tpu.config import ExperimentConfig
+from dorpatch_tpu.config import resolved_data_source
 from dorpatch_tpu.data import dataset_batches
 
 
@@ -72,9 +73,10 @@ def run_experiment_torch(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
     target_list: List[np.ndarray] = []
     records: List[List] = []
 
+    data_source = resolved_data_source(cfg)
     batches = dataset_batches(
         cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size, cfg.seed,
-        synthetic=cfg.synthetic_data,
+        source=data_source,
     )
     attack_seconds: List[float] = []
     generated_images = 0
@@ -86,8 +88,8 @@ def run_experiment_torch(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
 
         with torch.no_grad():
             preds = model(x).argmax(-1).numpy()
-        if cfg.synthetic_data:
-            y_np = preds.copy()
+        if data_source == "synthetic":
+            y_np = preds.copy()  # random labels -> score the model's own preds
         correct = preds == y_np
         if correct.sum() == 0:
             continue

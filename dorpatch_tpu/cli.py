@@ -38,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", default="jax-tpu", choices=["jax-tpu", "torch"])
     p.add_argument("--synthetic", action="store_true",
                    help="synthetic data (no dataset on disk needed)")
+    p.add_argument("--data-source", default="auto",
+                   choices=["auto", "disk", "synthetic", "procedural"],
+                   help="image stream: 'procedural' = the learnable "
+                   "generated task with genuine labels (trained-victim "
+                   "runs); 'auto' follows --synthetic")
     p.add_argument("--num-batches", type=int, default=10)
     p.add_argument("--max-iterations", type=int, default=5000)
     p.add_argument("--sampling-size", type=int, default=128)
@@ -114,6 +119,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         device=args.device,
         results_root=args.results_root,
         synthetic_data=args.synthetic,
+        data_source=args.data_source,
         img_size=args.img_size,
         gn_impl=args.gn_impl,
         mesh_data=args.mesh_data,

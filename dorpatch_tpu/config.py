@@ -99,6 +99,19 @@ class DefenseConfig:
     use_pallas: str = "auto"        # fused mask-fill kernel: auto|on|off|interpret
 
 
+def resolved_data_source(cfg: "ExperimentConfig") -> str:
+    """cfg.data_source with "auto" mapped through the synthetic_data flag.
+
+    getattr default: configs pickled before the field existed (cached
+    sweep/parity artifacts) resolve as "auto"."""
+    source = getattr(cfg, "data_source", "auto")
+    if source != "auto":
+        if source not in ("disk", "synthetic", "procedural"):
+            raise ValueError(f"unknown data_source {source!r}")
+        return source
+    return "synthetic" if cfg.synthetic_data else "disk"
+
+
 @dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
     """End-to-end experiment: the reference's CLI surface (`/root/reference/main.py:8-41`)
@@ -116,6 +129,12 @@ class ExperimentConfig:
     device: str = "0"
     results_root: str = "results"
     synthetic_data: bool = False    # run without datasets on disk
+    data_source: str = "auto"       # auto|disk|synthetic|procedural:
+                                    # "procedural" = the learnable generated
+                                    # task (data.procedural_arrays) with
+                                    # genuine labels — the trained-victim
+                                    # flagship's eval stream; "auto" maps
+                                    # synthetic_data to synthetic/disk
     img_size: int = 224
     gn_impl: str = "auto"           # GroupNorm+ReLU impl for ResNetV2 victims
                                     # (models.resnetv2.GroupNormRelu): auto =

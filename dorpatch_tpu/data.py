@@ -54,6 +54,94 @@ def synthetic_batches(
         yield imgs, labels.astype(np.int64)
 
 
+def procedural_arrays(
+    dataset: str,
+    n_per_class: int,
+    img_size: int = 32,
+    seed: int = 1234,
+    split: str = "train",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic procedurally-generated labeled images (NHWC [0,1], int64).
+
+    Purpose: this environment ships no datasets and has no network, so
+    "train the victim to real accuracy" (round-3 verdict) cannot use real
+    CIFAR-10. This generator produces a *learnable but nontrivial* 10-way
+    task instead: class = (orientation bucket x {linear, radial}) of a
+    sinusoidal grating, with per-sample random phase, frequency, center,
+    per-channel gain/base colors, a smooth background gradient, and additive
+    Gaussian noise. An untrained net scores ~chance; a trained net has real
+    decision boundaries — which is what makes attacking and certifying
+    against it scientifically meaningful.
+
+    Splits draw from disjoint seed streams; labels are genuine (generative),
+    unlike `synthetic_batches`' random labels.
+    """
+    n_classes = NUM_CLASSES[dataset]
+    # class geometry: n_orient orientation buckets x {linear, radial}. The
+    # buckets must stay separated well past the per-sample angle jitter
+    # (sd 0.06 rad); past ~20 classes neighboring orientations overlap and
+    # the "genuine labels" premise silently breaks — refuse rather than
+    # generate an unlearnable task (imagenet's 1000 classes would also
+    # allocate ~60 GB here).
+    if n_classes > 20:
+        raise ValueError(
+            f"procedural task supports <= 20 classes, got {n_classes} "
+            f"({dataset!r}); use dataset='cifar10'")
+    n_orient = (n_classes + 1) // 2
+    rng = np.random.default_rng([seed, {"train": 0, "test": 1}[split]])
+    n = n_classes * n_per_class
+    labels = np.repeat(np.arange(n_classes), n_per_class).astype(np.int64)
+    rng.shuffle(labels)
+
+    u, v = np.meshgrid(
+        np.linspace(0.0, 1.0, img_size, dtype=np.float32),
+        np.linspace(0.0, 1.0, img_size, dtype=np.float32),
+        indexing="xy",
+    )
+    imgs = np.empty((n, img_size, img_size, 3), np.float32)
+    for i0 in range(0, n, 512):
+        lab = labels[i0:i0 + 512]
+        m = lab.shape[0]
+        theta = (lab % n_orient) * (np.pi / n_orient) + rng.normal(0.0, 0.06, m)
+        ct, st = np.cos(theta)[:, None, None], np.sin(theta)[:, None, None]
+        radial = (lab >= n_orient)[:, None, None]
+        cx = rng.uniform(0.3, 0.7, (m, 1, 1)).astype(np.float32)
+        cy = rng.uniform(0.3, 0.7, (m, 1, 1)).astype(np.float32)
+        du, dv = u[None] - cx, v[None] - cy
+        s_lin = u[None] * ct + v[None] * st
+        s_rad = np.sqrt((du * ct + dv * st) ** 2
+                        + 3.0 * (-du * st + dv * ct) ** 2)
+        s = np.where(radial, s_rad, s_lin)
+        freq = rng.uniform(3.5, 6.5, (m, 1, 1))
+        phase = rng.uniform(0.0, 2 * np.pi, (m, 1, 1))
+        t = np.cos(2 * np.pi * freq * s + phase)[..., None]       # [m,H,W,1]
+        gain = rng.uniform(0.25, 0.45, (m, 1, 1, 3))
+        base = rng.uniform(0.35, 0.65, (m, 1, 1, 3))
+        w = rng.normal(0.0, 1.0, (m, 2, 1, 1, 1))
+        bg = 0.08 * (w[:, 0] * u[None, ..., None] + w[:, 1] * v[None, ..., None])
+        out = base + gain * t + bg + rng.normal(0.0, 0.07, t.shape)
+        imgs[i0:i0 + 512] = np.clip(out, 0.0, 1.0)
+    return imgs, labels
+
+
+def procedural_batches(
+    dataset: str,
+    batch_size: int,
+    img_size: int,
+    seed: int = 1234,
+    split: str = "test",
+    n_per_class: int = 100,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Shuffled batches of the procedural task's held-out split (the eval
+    stream the trained-victim flagship protocol attacks)."""
+    imgs, labels = procedural_arrays(dataset, n_per_class, img_size, seed, split)
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(imgs))
+    for i in range(0, len(order), batch_size):
+        sel = order[i:i + batch_size]
+        yield imgs[sel], labels[sel]
+
+
 def _load_cifar(data_dir: str, name: str):
     if name == "cifar10":
         base = os.path.join(data_dir, name, "cifar-10-batches-py")
@@ -90,12 +178,24 @@ def dataset_batches(
     img_size: int = 224,
     seed: int = 1234,
     synthetic: bool = False,
+    source: str = None,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Shuffled eval-split batches, NHWC float32 in [0,1] (the reference's
-    `get_dataset` with shuffle=True and the eval transform)."""
-    if synthetic:
+    `get_dataset` with shuffle=True and the eval transform).
+
+    source: "disk" | "synthetic" | "procedural" (None = disk unless
+    `synthetic`). "procedural" yields the generated task's held-out split
+    with genuine labels (see `procedural_arrays`)."""
+    source = source or ("synthetic" if synthetic else "disk")
+    if source == "synthetic":
         yield from synthetic_batches(dataset, batch_size, img_size, seed)
         return
+    if source == "procedural":
+        yield from procedural_batches(dataset, batch_size, img_size, seed,
+                                      split="test")
+        return
+    if source != "disk":
+        raise ValueError(f"unknown data source {source!r}")
 
     rng = np.random.default_rng(seed)
     if dataset in ("cifar10", "cifar100"):

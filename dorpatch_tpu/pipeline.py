@@ -22,7 +22,7 @@ import numpy as np
 from dorpatch_tpu import losses, metrics, observe, parallel, utils
 from dorpatch_tpu.artifacts import ArtifactStore, results_path
 from dorpatch_tpu.attack import DorPatch
-from dorpatch_tpu.config import ExperimentConfig
+from dorpatch_tpu.config import ExperimentConfig, resolved_data_source
 from dorpatch_tpu.data import dataset_batches
 from dorpatch_tpu.defense import build_defenses
 from dorpatch_tpu.models import get_model
@@ -87,9 +87,10 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
     target_list: List[np.ndarray] = []
     records: List[List] = []
 
+    data_source = resolved_data_source(cfg)
     batches = dataset_batches(
         cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size, cfg.seed,
-        synthetic=cfg.synthetic_data,
+        source=data_source,
     )
     timer = observe.StepTimer()
     generated_images = 0
@@ -103,9 +104,11 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
 
             # keep only correctly-classified images (`main.py:91-99`)
             preds = np.asarray(jnp.argmax(victim.apply(victim.params, x), -1))
-            if cfg.synthetic_data:
-                # synthetic labels are the model's own clean predictions, so the
-                # correctness filter is non-degenerate without a trained victim
+            if data_source == "synthetic":
+                # synthetic labels are random, so the correctness filter would
+                # be degenerate: score against the model's own clean
+                # predictions instead. Procedural labels are genuine — the
+                # filter keeps its reference semantics (`main.py:91-99`).
                 y_np = preds.copy()
             correct = preds == y_np
             if correct.sum() == 0:

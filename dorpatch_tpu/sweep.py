@@ -33,7 +33,8 @@ import numpy as np
 
 from dorpatch_tpu import losses, metrics, observe
 from dorpatch_tpu.attack import DorPatch
-from dorpatch_tpu.config import AttackConfig, DefenseConfig, ExperimentConfig
+from dorpatch_tpu.config import (AttackConfig, DefenseConfig, ExperimentConfig,
+                                  resolved_data_source)
 from dorpatch_tpu.data import dataset_batches
 from dorpatch_tpu.defense import build_defenses
 from dorpatch_tpu.models import get_model
@@ -54,14 +55,15 @@ def run_sweep(
     wall seconds."""
     victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size,
                        gn_impl=cfg.gn_impl)
+    data_source = resolved_data_source(cfg)
     x_np, y_np = next(iter(dataset_batches(
         cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size, cfg.seed,
-        synthetic=cfg.synthetic_data,
+        source=data_source,
     )))
     x = jnp.asarray(x_np)
     preds = jnp.argmax(victim.apply(victim.params, x), -1)
-    if cfg.synthetic_data:
-        y_np = np.asarray(preds)
+    if data_source == "synthetic":
+        y_np = np.asarray(preds)  # random labels -> score the model's own preds
     keep = np.asarray(preds) == y_np
     if not keep.any():
         raise RuntimeError("no correctly-classified images in the sweep batch")

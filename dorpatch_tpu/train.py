@@ -1,0 +1,208 @@
+"""Victim training: give the flagship protocol a victim that actually
+classifies.
+
+The reference consumes *pretrained* victims (PatchCleanser-release
+checkpoints, `/root/reference/utils.py:47-63`); it has no training code. This
+environment ships neither those checkpoints nor any dataset, so round 3's
+flagship ran against a randomly-initialized victim — mechanically complete
+but scientifically weak (round-3 verdict #5). This module closes that gap
+the only way possible offline: train `CifarResNet18` on the procedural
+labeled task (`data.procedural_arrays`) to real held-out accuracy, export
+the weights as a torch-style checkpoint under the reference's naming
+contract, and let the standard pipeline load it through the existing
+converter path (`models/registry.get_model` -> `convert_cifar_resnet18`).
+
+TPU-first design: one jitted `train_step` (fwd+bwd+adamw update) with the
+augmentation (pad-4 random crop + horizontal flip) inside the jit as pure
+`jax.random` ops on static shapes; the epoch loop is a host loop over
+device-resident uint8 data (casts per batch). Runs unchanged on CPU or a
+single TPU chip.
+
+Usage:
+  python -m dorpatch_tpu.train --out pretrained_models/ --epochs 12
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    dataset: str = "cifar10"
+    img_size: int = 32
+    n_per_class_train: int = 1500
+    n_per_class_test: int = 200
+    batch_size: int = 128
+    epochs: int = 12
+    lr: float = 3e-3
+    weight_decay: float = 1e-4
+    warmup_steps: int = 100
+    label_smoothing: float = 0.1
+    seed: int = 0
+
+
+def _augment(key, imgs):
+    """Pad-4 random crop + horizontal flip, batched, static shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    n, h, w, c = imgs.shape
+    kc, kf = jax.random.split(key)
+    padded = jnp.pad(imgs, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
+    off = jax.random.randint(kc, (n, 2), 0, 9)
+
+    def crop(img, o):
+        return jax.lax.dynamic_slice(img, (o[0], o[1], 0), (h, w, c))
+
+    imgs = jax.vmap(crop)(padded, off)
+    flip = jax.random.bernoulli(kf, 0.5, (n, 1, 1, 1))
+    return jnp.where(flip, imgs[:, :, ::-1, :], imgs)
+
+
+def train_victim(cfg: TrainConfig = TrainConfig(), log=print) -> Tuple[dict, dict]:
+    """Train CifarResNet18 on the procedural task; returns (params, report).
+
+    report: {"test_acc", "train_acc", "steps", "seconds", "backend"}.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dorpatch_tpu import data as data_lib
+    from dorpatch_tpu.models.small import CifarResNet18
+
+    tr_x, tr_y = data_lib.procedural_arrays(
+        cfg.dataset, cfg.n_per_class_train, cfg.img_size, seed=1234,
+        split="train")
+    te_x, te_y = data_lib.procedural_arrays(
+        cfg.dataset, cfg.n_per_class_test, cfg.img_size, seed=1234,
+        split="test")
+    n_classes = int(tr_y.max()) + 1
+
+    model = CifarResNet18(num_classes=n_classes)
+    key = jax.random.PRNGKey(cfg.seed)
+    params = jax.jit(model.init)(
+        key, jnp.zeros((1, cfg.img_size, cfg.img_size, 3)))
+
+    steps_per_epoch = len(tr_x) // cfg.batch_size
+    total_steps = steps_per_epoch * cfg.epochs
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, cfg.lr, cfg.warmup_steps, max(total_steps, cfg.warmup_steps + 1))
+    tx = optax.adamw(sched, weight_decay=cfg.weight_decay)
+    opt_state = tx.init(params)
+
+    # model normalization contract: victims see [0,1] images shifted by the
+    # pipeline's (x-0.5)/0.5 (registry.get_model) — train in the same frame
+    def loss_fn(params, key, x01, y):
+        x = _augment(key, x01)
+        logits = model.apply(params, (x - 0.5) / 0.5)
+        labels = optax.smooth_labels(
+            jax.nn.one_hot(y, n_classes), cfg.label_smoothing)
+        loss = optax.softmax_cross_entropy(logits, labels).mean()
+        return loss, (logits.argmax(-1) == y).mean()
+
+    @jax.jit
+    def train_step(params, opt_state, key, x_u8, y):
+        x01 = x_u8.astype(jnp.float32) / 255.0
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, key, x01, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, acc
+
+    @jax.jit
+    def eval_step(params, x_u8, y):
+        logits = model.apply(
+            params, (x_u8.astype(jnp.float32) / 255.0 - 0.5) / 0.5)
+        return (logits.argmax(-1) == y).sum()
+
+    # uint8 on device: 4x less HBM/L2 traffic than f32, cast inside the jit
+    dev_tr_x = jax.device_put((tr_x * 255).astype(np.uint8))
+    dev_tr_y = jax.device_put(tr_y)
+    dev_te_x = jax.device_put((te_x * 255).astype(np.uint8))
+    dev_te_y = jax.device_put(te_y)
+
+    def test_acc(params) -> float:
+        hits = 0
+        for i in range(0, len(te_x), 500):
+            hits += int(eval_step(params, dev_te_x[i:i + 500],
+                                  dev_te_y[i:i + 500]))
+        return hits / len(te_x)
+
+    rng = np.random.default_rng(cfg.seed)
+    t0 = time.perf_counter()
+    step = 0
+    train_acc = 0.0
+    for epoch in range(cfg.epochs):
+        order = rng.permutation(len(tr_x))
+        accs = []
+        for i in range(steps_per_epoch):
+            sel = jnp.asarray(order[i * cfg.batch_size:(i + 1) * cfg.batch_size])
+            key, sub = jax.random.split(key)
+            params, opt_state, loss, acc = train_step(
+                params, opt_state, sub, dev_tr_x[sel], dev_tr_y[sel])
+            accs.append(acc)
+            step += 1
+        train_acc = float(jnp.mean(jnp.stack(accs)))
+        log(f"epoch {epoch + 1}/{cfg.epochs}: train_acc={train_acc:.3f} "
+            f"({time.perf_counter() - t0:.0f}s)")
+    acc = test_acc(params)
+    report = {
+        "test_acc": acc,
+        "train_acc": train_acc,
+        "steps": step,
+        "seconds": round(time.perf_counter() - t0, 1),
+        "backend": jax.default_backend(),
+        "n_train": len(tr_x),
+        "n_test": len(te_x),
+    }
+    log(f"done: held-out acc={acc:.3f} ({report['seconds']}s on "
+        f"{report['backend']})")
+    return params, report
+
+
+def save_victim_checkpoint(params, out_dir: str, dataset: str = "cifar10") -> str:
+    """Export trained flax params as a torch `.pth` under the reference's
+    checkpoint naming contract, loadable by `models/registry.get_model`."""
+    from dorpatch_tpu.models import registry
+    from dorpatch_tpu.models.convert import export_cifar_resnet18
+
+    path = registry.checkpoint_path(out_dir, dataset, "cifar_resnet18")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    import torch
+
+    sd = {k: torch.as_tensor(v) for k, v in export_cifar_resnet18(params).items()}
+    torch.save({"state_dict": sd}, path)
+    return path
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="pretrained_models/",
+                   help="model dir to export the checkpoint into")
+    p.add_argument("--dataset", default="cifar10")
+    p.add_argument("--epochs", type=int, default=12)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--n-per-class", type=int, default=1500)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    cfg = TrainConfig(dataset=args.dataset, epochs=args.epochs,
+                      batch_size=args.batch_size, lr=args.lr, seed=args.seed,
+                      n_per_class_train=args.n_per_class)
+    params, report = train_victim(cfg)
+    path = save_victim_checkpoint(params, args.out, args.dataset)
+    print(f"saved {path}; report={report}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
