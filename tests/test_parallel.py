@@ -282,3 +282,54 @@ def test_two_process_multihost_feeding():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert f"proc {i}: OK" in out
+
+
+def test_sharded_block_hlo_has_allreduce_no_big_allgather():
+    """GSPMD-regression guard (r03 verdict #7): the compiled sharded attack
+    block must contain the mask-axis all-reduce (the loss/grad contraction
+    `shard_apply_fn` exists to produce) and must NOT all-gather the masked
+    `[B*S, H, W, C]` tensor — the replicate-everything pathology the
+    sharding constraint prevents. Static HLO proof in the spirit of
+    test_conv_policy_skips_conv_recompute_in_hlo."""
+    import re
+
+    from dorpatch_tpu import losses, masks as masks_lib
+    from dorpatch_tpu.models.small import CifarResNet18
+
+    img, batch, eot = 32, 2, 8
+    model = CifarResNet18(num_classes=10)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, img, img, 3)))
+    cfg = AttackConfig(sampling_size=eot, dropout=1, dropout_sizes=(0.06,),
+                       basic_unit=4)
+    mesh = make_mesh(1, 8)
+    atk = make_sharded_attack(model.apply, params, 10, cfg, mesh, remat=False)
+
+    universe = jnp.asarray(masks_lib.dropout_universe(
+        img, cfg.dropout, cfg.dropout_sizes))
+    key = jax.random.PRNGKey(1)
+    x = place_batch(mesh, jax.random.uniform(key, (batch, img, img, 3)))
+    y = jnp.zeros((batch,), jnp.int32)
+    local_var_x = jnp.mean(losses.local_variance(x)[0], axis=-1)
+    state = atk._init_state(key, x, y, False, universe.shape[0])
+
+    block = atk._get_block(1, img, 2)
+    txt = block.lower(state, x, local_var_x, universe).compile().as_text()
+
+    assert "all-reduce" in txt, "mask-axis loss/grad all-reduce missing"
+
+    # No all-gather may materialize anything as large as the full masked
+    # tensor (B*S*H*W*C elements); small gathers (logits, bookkeeping
+    # vectors) are legitimate.
+    full_masked = batch * eot * img * img * 3
+    gathered = []
+    for line in txt.splitlines():
+        if "all-gather(" not in line and "all-gather-start(" not in line:
+            continue
+        # HLO result shape sits after '=': `%name = f32[16,32,32,3]{...} all-gather(...)`
+        m = re.search(r"=\s*\(?\s*\w+\[([\d,]*)\]", line)
+        assert m, f"unparsed all-gather line: {line.strip()[:200]}"
+        dims = [int(d) for d in m.group(1).split(",") if d]
+        gathered.append((int(np.prod(dims)) if dims else 1, line.strip()))
+    big = [g for g in gathered if g[0] >= full_masked]
+    assert not big, f"all-gather of masked-tensor scale: {big[0][1][:200]}"
