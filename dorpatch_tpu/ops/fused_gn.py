@@ -24,14 +24,14 @@ Group statistics never touch HBM mid-kernel: per-channel sums are reduced to
 per-group sums with a tiny `[C, G]` one-hot matmul (MXU-friendly; lane axis
 stays C), and broadcast back with its transpose.
 
-The forward grid is one program per sample (whole [HW, C] slab in VMEM);
-the backward additionally has an HW-tiled two-pass variant
-(`_pallas_bwd_tiled`: tiled stats accumulation, then tiled dx) for slabs
-whose untiled live set busts the VMEM budget — admission is decided
-per-shape and per-dtype by `auto_pallas`/`_bwd_plan` (conservative
-double-buffered estimates; e.g. the largest RN50 slab, 56x56x256, is
-tiled at bf16 and routed to XLA at f32, whose whole-slab *forward*
-already exceeds the budget).
+Both directions take the whole [HW, C] slab per grid step when it fits
+VMEM, and fall back to an HW-tiled two-pass variant when it does not
+(`_pallas_fwd_tiled`: tiled group sums then tiled normalize;
+`_pallas_bwd_tiled`: tiled stats accumulation then tiled dx — one extra
+read of the streamed inputs as the price of fit). Admission is decided
+per-shape and per-dtype by `auto_pallas`/`_fwd_plan`/`_bwd_plan` with
+conservative double-buffered estimates; only slabs with no sublane-
+aligned tiling (pathological HW factorizations) route to XLA.
 
 `gn_relu` dispatches like `ops.masked_fill`: "auto" uses Pallas on a
 single-device TPU backend and the jnp reference elsewhere. Under a
@@ -125,9 +125,97 @@ def _bwd_kernel(g: int, x_ref, dy_ref, s_ref, b_ref, mean_ref, rstd_ref,
     db_ref[0] = db_c
 
 
+def _fwd_stats_kernel(g: int, x_ref, s1_ref, s2_ref):
+    """Tiled fwd phase 1: per-(sample, group) raw sums over HW tiles
+    (same accumulator pattern as `_bwd_stats_kernel`); mean/rstd are
+    finished by a tiny [N, G] jnp epilogue outside the kernel."""
+    t = pl.program_id(1)
+    xf = x_ref[0].astype(jnp.float32)                        # [HW/T, C]
+    c = xf.shape[1]
+    gm = _group_matrices(c, g)
+    s1 = jnp.sum(xf, axis=0, keepdims=True)                  # [1, C]
+    s2 = jnp.sum(xf * xf, axis=0, keepdims=True)
+
+    @pl.when(t == 0)
+    def _init():
+        s1_ref[0] = jnp.zeros_like(s1_ref[0])
+        s2_ref[0] = jnp.zeros_like(s2_ref[0])
+
+    s1_ref[0] += jnp.dot(s1, gm, preferred_element_type=jnp.float32)
+    s2_ref[0] += jnp.dot(s2, gm, preferred_element_type=jnp.float32)
+
+
+def _fwd_apply_kernel(g: int, x_ref, s_ref, b_ref, mean_ref, rstd_ref, y_ref):
+    """Tiled fwd phase 2: normalize+affine+ReLU per HW tile."""
+    xf = x_ref[0].astype(jnp.float32)
+    c = xf.shape[1]
+    gm = _group_matrices(c, g)
+    mean_c = jnp.dot(mean_ref[0], gm.T, preferred_element_type=jnp.float32)
+    mul_c = jnp.dot(rstd_ref[0], gm.T,
+                    preferred_element_type=jnp.float32) * s_ref[...]
+    y = (xf - mean_c) * mul_c + b_ref[...]
+    y_ref[0] = jnp.maximum(y, 0.0).astype(y_ref.dtype)
+
+
+def _pallas_fwd_tiled(x, scale, bias, g: int, eps: float, tiles: int,
+                      interpret: bool):
+    """Two-pass tiled forward: raw group sums per tile, mean/rstd finished
+    in jnp, then a tiled normalize pass. One extra read of x vs the
+    whole-slab kernel — the price of VMEM fit on oversize slabs."""
+    n, h, w, c = x.shape
+    hw = h * w
+    th = hw // tiles
+    xr = x.reshape(n, hw, c)
+    per_sample = lambda i, t: (i, 0, 0)  # noqa: E731 - accumulator blocks
+    s1, s2 = pl.pallas_call(
+        functools.partial(_fwd_stats_kernel, g),
+        grid=(n, tiles),
+        in_specs=[pl.BlockSpec((1, th, c), lambda i, t: (i, t, 0))],
+        out_specs=[
+            pl.BlockSpec((1, 1, g), per_sample),
+            pl.BlockSpec((1, 1, g), per_sample),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1, g), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1, g), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xr)
+    cnt = float(hw * (c // g))
+    mean = s1 / cnt                                          # [n, 1, g]
+    var = jnp.maximum(s2 / cnt - mean * mean, 0.0)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = pl.pallas_call(
+        functools.partial(_fwd_apply_kernel, g),
+        grid=(n, tiles),
+        in_specs=[
+            pl.BlockSpec((1, th, c), lambda i, t: (i, t, 0)),
+            pl.BlockSpec((1, c), lambda i, t: (0, 0)),
+            pl.BlockSpec((1, c), lambda i, t: (0, 0)),
+            pl.BlockSpec((1, 1, g), per_sample),
+            pl.BlockSpec((1, 1, g), per_sample),
+        ],
+        out_specs=pl.BlockSpec((1, th, c), lambda i, t: (i, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, hw, c), x.dtype),
+        interpret=interpret,
+    )(xr, scale.astype(jnp.float32).reshape(1, c),
+      bias.astype(jnp.float32).reshape(1, c), mean, rstd)
+    return y.reshape(n, h, w, c), mean, rstd
+
+
 def _pallas_fwd(x, scale, bias, g: int, eps: float, interpret: bool):
     n, h, w, c = x.shape
     hw = h * w
+    tiles = _fwd_plan(hw, c, jnp.dtype(x.dtype).itemsize)
+    if tiles is None:
+        if interpret:
+            tiles = 1  # the interpreter has no VMEM constraint
+        else:
+            raise ValueError(
+                f"no VMEM-feasible forward plan for slab ({hw},{c}) "
+                f"{x.dtype}; auto_pallas should have routed this to XLA")
+    if tiles > 1:
+        return _pallas_fwd_tiled(x, scale, bias, g, eps, tiles, interpret)
     y, mean, rstd = pl.pallas_call(
         functools.partial(_fwd_kernel, g, eps),
         grid=(n,),
@@ -270,9 +358,12 @@ def _pallas_bwd(x, dy, scale, bias, mean, rstd, g: int, interpret: bool):
     hw = h * w
     tiles = _bwd_plan(hw, c, jnp.dtype(x.dtype).itemsize)
     if tiles is None:
-        raise ValueError(
-            f"no VMEM-feasible backward plan for slab ({hw},{c}) "
-            f"{x.dtype}; auto_pallas should have routed this to XLA")
+        if interpret:
+            tiles = 1  # the interpreter has no VMEM constraint
+        else:
+            raise ValueError(
+                f"no VMEM-feasible backward plan for slab ({hw},{c}) "
+                f"{x.dtype}; auto_pallas should have routed this to XLA")
     if tiles > 1:
         return _pallas_bwd_tiled(x, dy, scale, bias, mean, rstd, g, tiles,
                                  interpret)
@@ -351,28 +442,35 @@ def _bwd_vmem_bytes(tile_elems: int, itemsize: int) -> int:
     return 2 * 3 * tile_elems * itemsize + 4 * tile_elems * 4
 
 
-def _bwd_plan(hw: int, c: int, itemsize: int):
-    """How to run the backward for a [HW, C] slab: 1 = whole-slab kernel,
-    T > 1 = `_pallas_bwd_tiled` with T HW-tiles, None = no feasible plan
-    (route to XLA). Tiles must divide HW on a Mosaic-aligned row boundary
-    (sublane multiple: 16 rows at bf16, 8 at f32)."""
-    if _bwd_vmem_bytes(hw * c, itemsize) <= _VMEM_BUDGET_BYTES:
+def _tile_plan(hw: int, c: int, itemsize: int, vmem_fn):
+    """1 = whole-slab kernel fits, T > 1 = T HW-tiles, None = no feasible
+    plan (route to XLA). Tiles must divide HW on a Mosaic-aligned row
+    boundary (sublane multiple: 16 rows at bf16, 8 at f32)."""
+    if vmem_fn(hw * c, itemsize) <= _VMEM_BUDGET_BYTES:
         return 1
     align = 16 if itemsize == 2 else 8
     for t in range(2, min(hw, _MAX_BWD_TILES) + 1):
         if hw % t or (hw // t) % align:
             continue
-        if _bwd_vmem_bytes((hw // t) * c, itemsize) <= _VMEM_BUDGET_BYTES:
+        if vmem_fn((hw // t) * c, itemsize) <= _VMEM_BUDGET_BYTES:
             return t
     return None
+
+
+def _fwd_plan(hw: int, c: int, itemsize: int):
+    return _tile_plan(hw, c, itemsize, _fwd_vmem_bytes)
+
+
+def _bwd_plan(hw: int, c: int, itemsize: int):
+    return _tile_plan(hw, c, itemsize, _bwd_vmem_bytes)
 
 
 def auto_pallas(x_shape=None, x_dtype=None) -> bool:
     """Dispatch predicate for impl="auto": the Pallas kernel on a
     single-device TPU backend (and, when `x_shape` [N,H,W,C] is given, only
-    when the forward's whole-slab live set fits the VMEM budget AND a
-    feasible backward plan exists — dtype-aware, bf16 slabs stream at half
-    the f32 rate); the GSPMD-partitionable path elsewhere."""
+    when feasible forward AND backward plans exist — whole-slab or HW-tiled,
+    dtype-aware since bf16 slabs stream at half the f32 rate); the
+    GSPMD-partitionable path elsewhere."""
     from dorpatch_tpu.ops._backend import is_tpu_backend
 
     try:
@@ -382,7 +480,7 @@ def auto_pallas(x_shape=None, x_dtype=None) -> bool:
     if ok and x_shape is not None:
         n, h, w, c = x_shape
         itemsize = jnp.dtype(x_dtype).itemsize if x_dtype is not None else 4
-        ok = (_fwd_vmem_bytes(h * w * c, itemsize) <= _VMEM_BUDGET_BYTES
+        ok = (_fwd_plan(h * w, c, itemsize) is not None
               and _bwd_plan(h * w, c, itemsize) is not None)
     return ok
 

@@ -133,13 +133,15 @@ def test_auto_dispatch_gates():
             # 401k-elem slabs: bf16 untiled; f32 via the tiled backward
             assert fused_gn.auto_pallas((8, 56, 56, 128), jnp.bfloat16)
             assert fused_gn.auto_pallas((8, 56, 56, 128), jnp.float32)
-            # largest RN50 slab (803k elems): bf16 admitted via the tiled
-            # backward; f32 busts the *forward's* whole-slab live set
+            # largest RN50 slab (803k elems): admitted at every dtype via
+            # the tiled plans (bf16: fwd whole-slab + bwd 2 tiles; f32:
+            # fwd 2 tiles + bwd 4 tiles)
             assert fused_gn.auto_pallas((8, 56, 56, 256), jnp.bfloat16)
-            assert not fused_gn.auto_pallas((8, 56, 56, 256), jnp.float32)
-            # no dtype given -> conservative f32 accounting
-            assert not fused_gn.auto_pallas((8, 56, 56, 256))
-            assert not fused_gn.auto_pallas((8, 96, 96, 256))  # 9 MB slab
+            assert fused_gn.auto_pallas((8, 56, 56, 256), jnp.float32)
+            assert fused_gn.auto_pallas((8, 96, 96, 256))  # 9 MB slab, tiled
+            # pathological HW factorization (97^2: no aligned divisor):
+            # no feasible plan at any dtype -> XLA path
+            assert not fused_gn.auto_pallas((8, 97, 97, 1024), jnp.float32)
     finally:
         _backend.is_tpu_backend = orig
 
@@ -158,9 +160,35 @@ def test_vmem_estimates_and_bwd_plan():
     assert fused_gn._bwd_plan(56 * 56, 256, 2) == 2
     # f32 401k slab: tiled too (8-row alignment admits t=2)
     assert fused_gn._bwd_plan(56 * 56, 128, 4) == 2
+    # f32 largest slab: forward needs 2 tiles, backward 4
+    assert fused_gn._fwd_plan(56 * 56, 256, 4) == 2
+    assert fused_gn._bwd_plan(56 * 56, 256, 4) == 4
     # big slab with pathological factorization (97^2 rows: the only
     # divisor <= 256 is 97, not sublane-aligned): no feasible plan
     assert fused_gn._bwd_plan(97 * 97, 1024, 4) is None
+    assert fused_gn._fwd_plan(97 * 97, 1024, 4) is None
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-5), (jnp.bfloat16, 0.02)])
+def test_tiled_forward_matches_reference(dtype, tol):
+    """`_pallas_fwd_tiled` (two-pass group sums + tiled normalize) against
+    the jnp reference, plus its mean/rstd residuals against the untiled
+    kernel (the custom VJP consumes them)."""
+    k = jax.random.PRNGKey(21)
+    n, h, w, c, g = 2, 8, 8, 64, 32
+    x = _rand(k, (n, h, w, c), dtype)
+    scale = _rand(jax.random.PRNGKey(22), (c,), jnp.float32) * 0.5 + 1.0
+    bias = _rand(jax.random.PRNGKey(23), (c,), jnp.float32) * 0.1
+
+    want = fused_gn.gn_relu_reference(x, scale, bias, g)
+    y, mean, rstd = fused_gn._pallas_fwd_tiled(x, scale, bias, g, 1e-5,
+                                               tiles=4, interpret=True)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(want, np.float32), atol=tol)
+    assert y.dtype == x.dtype
+    _, mean_u, rstd_u = fused_gn._pallas_fwd(x, scale, bias, g, 1e-5, True)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mean_u), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(rstd), np.asarray(rstd_u), atol=1e-4)
 
 
 @pytest.mark.parametrize("dtype,tol", [(jnp.float32, 1e-4), (jnp.bfloat16, 0.05)])

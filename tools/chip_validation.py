@@ -174,6 +174,26 @@ def main():
     for name, step in STEPS.items():
         if only is not None and name.split("_")[0] not in only:
             continue
+        if name == "8_flagship_trained":
+            # the flagship is only meaningful against the step-7 victim: a
+            # failed/timed-out training must not burn 45 min of the
+            # exclusive device grant against a missing checkpoint, nor
+            # silently consume a stale artifacts/victim_r04 from an
+            # earlier round and mislabel the row as "trained-victim"
+            trained = (results.get("7_train_victim") or {}).get("parsed")
+            ckpt = os.path.join(ROOT, "artifacts", "victim_r04", "cifar10",
+                                "cifar_resnet18_cutout2_128_cifar10.pth")
+            if not trained or not os.path.exists(ckpt):
+                results[name] = {"parsed": None, "rc": None, "seconds": 0,
+                                 "error": "skipped: step 7 training did not "
+                                          "complete (no checkpoint)"}
+                os.makedirs(os.path.dirname(args.out), exist_ok=True)
+                tmp = args.out + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(results, f, indent=1)
+                os.replace(tmp, args.out)
+                print(json.dumps({name: results[name]["error"]}), flush=True)
+                continue
         print(f"== {name}", flush=True)
         parse, res = step(args.timeout)
         parsed = parse(res)
