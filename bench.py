@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Headline benchmark: patch-optimization throughput of the jitted DorPatch
-stage-1 step (EOT=32 occlusion samples, ResNetV2-50x1 BiT @224) vs the torch
-CPU reference path (BASELINE.json config 1: single image, EOT=1).
+stage-1 step (EOT=128 occlusion samples — the reference's sampling_size,
+`/root/reference/attack.py:53` — ResNetV2-50x1 BiT @224) vs the torch CPU
+reference path (BASELINE.json config 1: single image, EOT=1).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
@@ -32,7 +33,8 @@ are real but not "useful" — MFU is reported on the 3x count either way.
 
 Env overrides: BENCH_MODE ("attack" default; "certify" times the
 PatchCleanser 666-mask certification path instead — see `_certify_bench`),
-BENCH_BATCH (default 8), BENCH_EOT (32), BENCH_BLOCK (8 steps
+BENCH_BATCH (default 4), BENCH_EOT (128 — the reference sampling_size;
+r03 measured batch 4 x EOT 128 fitting v5e HBM without remat), BENCH_BLOCK (8 steps
 per jitted block), BENCH_REPS (3 timed blocks), BENCH_WARMUP (3 untimed
 steady-state warm-up calls after compile — see the warm-up note in
 `child_jax`), BENCH_TORCH_ITERS (3), BENCH_ARCH / BENCH_DATASET / BENCH_IMG
@@ -157,8 +159,8 @@ def child_jax() -> None:
     dataset = os.environ.get("BENCH_DATASET", "imagenet")
     arch = os.environ.get("BENCH_ARCH", "resnetv2")
     img = int(os.environ.get("BENCH_IMG", "224"))
-    batch = int(os.environ.get("BENCH_BATCH", "8"))
-    eot = int(os.environ.get("BENCH_EOT", "32"))
+    batch = int(os.environ.get("BENCH_BATCH", "4"))
+    eot = int(os.environ.get("BENCH_EOT", "128"))
     block_steps = int(os.environ.get("BENCH_BLOCK", "8"))
     reps = int(os.environ.get("BENCH_REPS", "3"))
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
@@ -488,7 +490,7 @@ def main() -> None:
                           "error": f"unknown BENCH_GN={gn!r} (use 'auto', "
                                    "'flax', 'pallas', 'interpret' or 'jnp')"}))
         return
-    eot = int(os.environ.get("BENCH_EOT", "32"))
+    eot = int(os.environ.get("BENCH_EOT", "128"))
     jax_timeout = int(os.environ.get("BENCH_JAX_TIMEOUT", "1800"))
     torch_timeout = int(os.environ.get("BENCH_TORCH_TIMEOUT", "600"))
     arch = os.environ.get("BENCH_ARCH", "resnetv2")
@@ -537,7 +539,7 @@ def main() -> None:
         # still gets a self-consistent (same-model) ratio row.
         log(f"accelerator path abandoned ({failure}); CPU fallback")
         fallback = {"BENCH_DATASET": "cifar10", "BENCH_ARCH": "resnet18",
-                    "BENCH_IMG": "32", "BENCH_BATCH": "2",
+                    "BENCH_IMG": "32", "BENCH_BATCH": "2", "BENCH_EOT": "8",
                     # XLA-CPU emulates bf16 (slower than f32): keep the
                     # fallback row honest
                     "BENCH_DTYPE": "float32", **no_axon_env()}

@@ -48,6 +48,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sampling-size", type=int, default=128)
     p.add_argument("--basic-unit", type=int, default=7,
                    help="patch group cell size (reference hardcodes 7)")
+    p.add_argument("--switch-iteration", type=int, default=500,
+                   help="stage-0 untargeted->targeted switch iteration "
+                        "(reference hardcodes 500); scale down with "
+                        "--max-iterations on reduced budgets")
+    p.add_argument("--sweep-interval", type=int, default=100,
+                   help="full-universe failure-sweep cadence in iterations "
+                        "(reference hardcodes 100)")
+    p.add_argument("--failure-sampling-start", type=int, default=1000,
+                   help="iteration from which mask sampling biases toward "
+                        "the failure set (reference hardcodes 1000)")
     p.add_argument("--img-size", type=int, default=224)
     p.add_argument("--seed", type=int, default=1234)
     p.add_argument("--results-root", default="results")
@@ -94,6 +104,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         targeted=args.targeted,
         lr=args.lr,
         max_iterations=args.max_iterations,
+        switch_iteration=args.switch_iteration,
+        sweep_interval=args.sweep_interval,
+        failure_sampling_start=args.failure_sampling_start,
         basic_unit=args.basic_unit,
         dropout=args.dropout,
         sampling_size=args.sampling_size,

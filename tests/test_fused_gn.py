@@ -314,3 +314,22 @@ def test_invalid_args():
     x = jnp.zeros((1, 2, 2, 64))
     with pytest.raises(ValueError):
         fused_gn.gn_relu(x, jnp.ones((64,)), jnp.zeros((64,)), 32, impl="bogus")
+
+
+def test_interpret_bypasses_vmem_plan():
+    """impl="interpret" must work even on slabs with NO feasible VMEM plan
+    (the interpreter has no VMEM): hw=97*97 has no sublane-aligned tiling,
+    so auto_pallas rejects it, but an explicit interpreter call computes."""
+    n, h, w, c, g = 1, 97, 97, 64, 32
+    assert fused_gn._bwd_plan(h * w, c, 4) is None
+    x = _rand(jax.random.PRNGKey(31), (n, h, w, c), jnp.float32)
+    scale, bias = jnp.ones((c,)), jnp.zeros((c,))
+    want = fused_gn.gn_relu_reference(x, scale, bias, g)
+    got, vjp = jax.vjp(
+        lambda x: fused_gn.gn_relu(x, scale, bias, g, impl="interpret"), x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+    (dx,) = vjp(jnp.ones_like(got))
+    dx_ref = jax.grad(lambda x: jnp.sum(
+        fused_gn.gn_relu_reference(x, scale, bias, g)))(x)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                               atol=1e-4, rtol=1e-4)

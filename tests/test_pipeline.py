@@ -199,3 +199,22 @@ def test_procedural_e2e_uses_genuine_labels(tmp_path):
     # untrained victim on a 10-way task: most images fail the correctness
     # filter; whatever survives was genuinely classified correctly
     assert m["evaluated_images"] <= 8
+
+
+def test_cli_iteration_schedule_flags():
+    """The reference's hardcoded schedule constants (switch at 500, sweep
+    every 100, failure-biased sampling from 1000) are CLI-tunable so
+    reduced-budget protocol runs keep the schedule's *shape*."""
+    args = build_parser().parse_args(
+        ["-d", "cifar10", "--max-iterations", "300",
+         "--switch-iteration", "150", "--sweep-interval", "75",
+         "--failure-sampling-start", "150"])
+    cfg = config_from_args(args)
+    assert cfg.attack.switch_iteration == 150
+    assert cfg.attack.sweep_interval == 75
+    assert cfg.attack.failure_sampling_start == 150
+    # defaults stay at the reference's constants
+    dflt = config_from_args(build_parser().parse_args(["-d", "cifar10"]))
+    assert dflt.attack.switch_iteration == 500
+    assert dflt.attack.sweep_interval == 100
+    assert dflt.attack.failure_sampling_start == 1000
