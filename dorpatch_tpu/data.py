@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Iterator, Tuple
+from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -142,14 +142,55 @@ def procedural_batches(
         yield imgs[sel], labels[sel]
 
 
-def _load_cifar(data_dir: str, name: str):
+def training_arrays(
+    dataset: str,
+    source: str,
+    data_dir: str = "data/",
+    n_per_class: int = 1500,
+    img_size: int = 32,
+    seed: int = 1234,
+    split: str = "train",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Whole-split arrays for victim training: NHWC float32 [0,1] + int64.
+
+    source="procedural" generates the labeled task offline; source="disk"
+    loads real CIFAR batches (train split = `data_batch_1..5` / `train`,
+    the reference's `train=True` path, `/root/reference/utils.py:81-102`).
+    CIFAR images stay at their native 32px here — `train.py` trains at
+    img_size 32, so no eval-style resize/crop is applied."""
+    if source == "procedural":
+        return procedural_arrays(dataset, n_per_class, img_size, seed, split)
+    if source == "disk":
+        if dataset not in ("cifar10", "cifar100"):
+            raise ValueError(
+                f"disk training data supports cifar only, got {dataset!r}")
+        if img_size != 32:
+            raise ValueError("disk cifar training is native-32px only")
+        imgs, labels = _load_cifar(data_dir, dataset, split)
+        return imgs.astype(np.float32) / 255.0, labels
+    raise ValueError(f"unknown training data source {source!r}")
+
+
+def _load_cifar(data_dir: str, name: str, split: str = "test"):
+    """uint8 NHWC images + int64 labels from the standard pickle batches.
+
+    split="train" reads the training batches (`data_batch_1..5` for cifar10,
+    `train` for cifar100), mirroring the reference's `train=True` loaders
+    (`/root/reference/utils.py:81-102`); missing cifar10 train batches are
+    skipped so a partial download still loads (at least one must exist)."""
     if name == "cifar10":
         base = os.path.join(data_dir, name, "cifar-10-batches-py")
-        paths = [os.path.join(base, "test_batch")]
+        if split == "train":
+            paths = [p for i in range(1, 6)
+                     if os.path.exists(p := os.path.join(base, f"data_batch_{i}"))]
+            if not paths:
+                raise FileNotFoundError(f"no data_batch_* under {base}")
+        else:
+            paths = [os.path.join(base, "test_batch")]
         label_key = b"labels"
     else:
         base = os.path.join(data_dir, name, "cifar-100-python")
-        paths = [os.path.join(base, "test")]
+        paths = [os.path.join(base, "train" if split == "train" else "test")]
         label_key = b"fine_labels"
     imgs, labels = [], []
     for p in paths:
@@ -178,14 +219,17 @@ def dataset_batches(
     img_size: int = 224,
     seed: int = 1234,
     synthetic: bool = False,
-    source: str = None,
+    source: Optional[str] = None,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Shuffled eval-split batches, NHWC float32 in [0,1] (the reference's
     `get_dataset` with shuffle=True and the eval transform).
 
     source: "disk" | "synthetic" | "procedural" (None = disk unless
     `synthetic`). "procedural" yields the generated task's held-out split
-    with genuine labels (see `procedural_arrays`)."""
+    with genuine labels (see `procedural_arrays`) — fixed at 100 images
+    per class (a 1000-image eval split for cifar10); callers needing a
+    different size use `procedural_batches` directly. Train-split loading
+    for victim training goes through `training_arrays`, not this stream."""
     source = source or ("synthetic" if synthetic else "disk")
     if source == "synthetic":
         yield from synthetic_batches(dataset, batch_size, img_size, seed)

@@ -426,7 +426,7 @@ _gn_relu_pallas.defvjp(_vjp_fwd, _vjp_bwd)
 # 14 MB of the ~16 MB/core (v5e), leaving headroom for the tiny
 # stats/affine blocks and kernel bookkeeping.
 _VMEM_BUDGET_BYTES = 14 * 1024 * 1024
-_MAX_BWD_TILES = 256
+_MAX_TILES = 256  # caps the search in _tile_plan (forward AND backward)
 
 
 def _fwd_vmem_bytes(slab_elems: int, itemsize: int) -> int:
@@ -445,11 +445,14 @@ def _bwd_vmem_bytes(tile_elems: int, itemsize: int) -> int:
 def _tile_plan(hw: int, c: int, itemsize: int, vmem_fn):
     """1 = whole-slab kernel fits, T > 1 = T HW-tiles, None = no feasible
     plan (route to XLA). Tiles must divide HW on a Mosaic-aligned row
-    boundary (sublane multiple: 16 rows at bf16, 8 at f32)."""
+    boundary (sublane multiple: 16 rows at bf16, 8 at f32). Only exact
+    divisors of HW are considered — no padded tiles — so an unfriendly
+    factorization (e.g. HW = 2p for a large prime p) falls to XLA even
+    when a padded tiling would fit; RN50 slabs are all power-of-two HW."""
     if vmem_fn(hw * c, itemsize) <= _VMEM_BUDGET_BYTES:
         return 1
     align = 16 if itemsize == 2 else 8
-    for t in range(2, min(hw, _MAX_BWD_TILES) + 1):
+    for t in range(2, min(hw, _MAX_TILES) + 1):
         if hw % t or (hw // t) % align:
             continue
         if vmem_fn((hw // t) * c, itemsize) <= _VMEM_BUDGET_BYTES:

@@ -47,6 +47,10 @@ class TrainConfig:
     warmup_steps: int = 100
     label_smoothing: float = 0.1
     seed: int = 0
+    # "procedural" (offline generated task) or "disk" (real CIFAR batches,
+    # the reference's train=True path) — see data.training_arrays
+    data_source: str = "procedural"
+    data_dir: str = "data/"
 
 
 def _augment(key, imgs):
@@ -79,12 +83,14 @@ def train_victim(cfg: TrainConfig = TrainConfig(), log=print) -> Tuple[dict, dic
     from dorpatch_tpu import data as data_lib
     from dorpatch_tpu.models.small import CifarResNet18
 
-    tr_x, tr_y = data_lib.procedural_arrays(
-        cfg.dataset, cfg.n_per_class_train, cfg.img_size, seed=1234,
-        split="train")
-    te_x, te_y = data_lib.procedural_arrays(
-        cfg.dataset, cfg.n_per_class_test, cfg.img_size, seed=1234,
-        split="test")
+    tr_x, tr_y = data_lib.training_arrays(
+        cfg.dataset, cfg.data_source, cfg.data_dir,
+        n_per_class=cfg.n_per_class_train, img_size=cfg.img_size,
+        seed=1234, split="train")
+    te_x, te_y = data_lib.training_arrays(
+        cfg.dataset, cfg.data_source, cfg.data_dir,
+        n_per_class=cfg.n_per_class_test, img_size=cfg.img_size,
+        seed=1234, split="test")
     n_classes = int(tr_y.max()) + 1
 
     model = CifarResNet18(num_classes=n_classes)
@@ -93,6 +99,13 @@ def train_victim(cfg: TrainConfig = TrainConfig(), log=print) -> Tuple[dict, dic
         key, jnp.zeros((1, cfg.img_size, cfg.img_size, 3)))
 
     steps_per_epoch = len(tr_x) // cfg.batch_size
+    if steps_per_epoch == 0:
+        # reachable via --data-source disk with a partial download (one
+        # small data_batch_* file): fail with the cause, not an empty-stack
+        # error deep in the epoch loop
+        raise ValueError(
+            f"{len(tr_x)} training images < batch_size {cfg.batch_size}: "
+            "not enough data for one step (partial dataset?)")
     total_steps = steps_per_epoch * cfg.epochs
     sched = optax.warmup_cosine_decay_schedule(
         0.0, cfg.lr, cfg.warmup_steps, max(total_steps, cfg.warmup_steps + 1))
@@ -193,11 +206,16 @@ def main(argv=None) -> int:
     p.add_argument("--n-per-class", type=int, default=1500)
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--data-source", default="procedural",
+                   choices=("procedural", "disk"),
+                   help="disk = real CIFAR train batches under --data-dir")
+    p.add_argument("--data-dir", default="data/")
     args = p.parse_args(argv)
 
     cfg = TrainConfig(dataset=args.dataset, epochs=args.epochs,
                       batch_size=args.batch_size, lr=args.lr, seed=args.seed,
-                      n_per_class_train=args.n_per_class)
+                      n_per_class_train=args.n_per_class,
+                      data_source=args.data_source, data_dir=args.data_dir)
     params, report = train_victim(cfg)
     path = save_victim_checkpoint(params, args.out, args.dataset)
     print(f"saved {path}; report={report}")

@@ -1,0 +1,70 @@
+"""tools/parity_flagship.py mechanics: artifact staging must hand the torch
+oracle the jax patches but never the jax certification records, and the
+parity table arithmetic must be exact. The real evidence artifact
+(artifacts/PARITY_r05.json) comes from running the tool after the
+chip-validation flagship; these tests pin the logic it relies on."""
+
+import importlib.util
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "parity_flagship",
+    os.path.join(os.path.dirname(__file__), "..", "tools",
+                 "parity_flagship.py"))
+parity = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(parity)
+
+
+def test_stage_oracle_root_excludes_pc_cache(tmp_path):
+    jax_root = tmp_path / "jax" / "cfg=1" / "sub=2"
+    jax_root.mkdir(parents=True)
+    for name in ("adv_mask_0.pt", "adv_pattern_0.pt", "targets_0.pt",
+                 "adv_PC_0.pt", "adv_mask_1.pt"):
+        (jax_root / name).write_bytes(b"x")
+    (jax_root / "summary.json").write_text("{}")
+
+    oracle = tmp_path / "oracle"
+    n = parity.stage_oracle_root(str(tmp_path / "jax"), str(oracle))
+    assert n == 4
+    staged = sorted(os.path.basename(p) for p in
+                    (oracle / "cfg=1" / "sub=2").iterdir())
+    # the PC record cache must NOT cross over: the torch oracle would load
+    # it and re-score jax's own certifications instead of recomputing
+    assert staged == ["adv_mask_0.pt", "adv_mask_1.pt", "adv_pattern_0.pt",
+                      "targets_0.pt"]
+
+
+def test_parity_rows_and_delta():
+    jax_m = {"evaluated_images": 16, "clean_accuracy": 100.0,
+             "robust_accuracy": 6.25,
+             "acc_pc": [10.0, 20.0, 30.0, 40.0],
+             "certified_acc_pc": [1.0, 2.0, 3.0, 4.0],
+             "certified_asr_pc": [50.0, 60.0, 70.0, 80.0]}
+    torch_m = {"evaluated_images": 16, "clean_accuracy": 100.0,
+               "robust_accuracy": 6.25,
+               "acc_pc": [10.0, 20.0, 30.0, 40.0],
+               "certified_acc_pc": [1.0, 2.0, 3.0, 4.0],
+               "certified_asr_pc": [50.0, 60.0, 70.0, 81.5]}
+    rows = parity.parity_rows(jax_m, torch_m)
+    by_metric = {r["metric"]: r for r in rows}
+    assert by_metric["evaluated_images"]["delta"] == 0
+    assert by_metric["certified_asr_pc@12%"]["delta"] == -1.5
+    assert by_metric["certified_asr_pc@1.5%"]["delta"] == 0.0
+    # the tool's parity gate keys off certified_asr rows only
+    asr_deltas = [abs(r["delta"]) for r in rows
+                  if r["metric"].startswith("certified_asr")]
+    assert max(asr_deltas) == 1.5
+
+
+def test_flagship_config_matches_chip_validation_step8():
+    """The oracle must score the SAME protocol chip_validation step 8 ran:
+    drift here silently breaks the 'same seeds and images' premise."""
+    cfg = parity.flagship_config("/tmp/x", "torch")
+    assert (cfg.dataset, cfg.base_arch, cfg.img_size) == ("cifar10",
+                                                          "resnet18", 32)
+    assert (cfg.batch_size, cfg.num_batches) == (8, 2)
+    assert cfg.data_source == "procedural"
+    assert cfg.attack.sampling_size == 128
+    assert cfg.attack.max_iterations == 600
+    assert cfg.seed == 1234  # both runs inherit the default seed
+    assert cfg.model_dir.endswith(os.path.join("artifacts", "victim_r05"))

@@ -74,6 +74,79 @@ def test_train_step_learns_and_checkpoint_round_trips(tmp_path):
     assert path.endswith("cifar_resnet18_cutout2_128_cifar10.pth")
 
 
+def _write_cifar10_batch(path, n, seed, label_key=b"labels"):
+    import pickle
+
+    rng = np.random.default_rng(seed)
+    d = {b"data": rng.integers(0, 256, (n, 3 * 32 * 32), dtype=np.uint8),
+         label_key: rng.integers(0, 10, n).tolist()}
+    with open(path, "wb") as f:
+        pickle.dump(d, f)
+
+
+def test_cifar_train_split_loads_data_batches(tmp_path):
+    """split="train" reads data_batch_1..5 (the reference's train=True path,
+    utils.py:81-102), tolerating missing batch files; split="test" is
+    unchanged. training_arrays feeds this to train.py as float [0,1]."""
+    base = tmp_path / "cifar10" / "cifar-10-batches-py"
+    base.mkdir(parents=True)
+    _write_cifar10_batch(base / "data_batch_1", 6, seed=1)
+    _write_cifar10_batch(base / "data_batch_3", 4, seed=2)  # gap: no batch 2
+    _write_cifar10_batch(base / "test_batch", 5, seed=3)
+
+    imgs, labels = data_lib._load_cifar(str(tmp_path), "cifar10", split="train")
+    assert imgs.shape == (10, 32, 32, 3) and labels.shape == (10,)
+    te_imgs, _ = data_lib._load_cifar(str(tmp_path), "cifar10", split="test")
+    assert te_imgs.shape == (5, 32, 32, 3)
+
+    x, y = data_lib.training_arrays("cifar10", "disk", str(tmp_path),
+                                    split="train")
+    assert x.dtype == np.float32 and x.shape == (10, 32, 32, 3)
+    assert 0.0 <= x.min() and x.max() <= 1.0
+    assert np.array_equal(
+        x, imgs.astype(np.float32) / 255.0) and np.array_equal(y, labels)
+
+    with pytest.raises(FileNotFoundError, match="data_batch"):
+        data_lib._load_cifar(str(tmp_path / "empty"), "cifar10", split="train")
+
+
+def test_train_victim_rejects_sub_batch_dataset():
+    """A partial dataset smaller than one batch must fail with the cause,
+    not an empty-stack error deep in the epoch loop."""
+    from dorpatch_tpu.train import TrainConfig, train_victim
+
+    cfg = TrainConfig(n_per_class_train=1, batch_size=128)
+    with pytest.raises(ValueError, match="not enough data"):
+        train_victim(cfg, log=lambda *a: None)
+
+
+def test_training_arrays_disk_guards():
+    with pytest.raises(ValueError, match="cifar only"):
+        data_lib.training_arrays("imagenet", "disk")
+    with pytest.raises(ValueError, match="native-32px"):
+        data_lib.training_arrays("cifar10", "disk", img_size=224)
+    with pytest.raises(ValueError, match="unknown training data source"):
+        data_lib.training_arrays("cifar10", "nope")
+
+
+@pytest.mark.slow
+def test_train_victim_consumes_disk_cifar(tmp_path):
+    """train.py --data-source disk: one tiny epoch over fabricated CIFAR
+    batches runs end-to-end (mechanics; real accuracy needs real data)."""
+    from dorpatch_tpu.train import TrainConfig, train_victim
+
+    base = tmp_path / "cifar10" / "cifar-10-batches-py"
+    base.mkdir(parents=True)
+    _write_cifar10_batch(base / "data_batch_1", 96, seed=11)
+    _write_cifar10_batch(base / "test_batch", 32, seed=12)
+
+    cfg = TrainConfig(epochs=1, batch_size=48, warmup_steps=2, seed=1,
+                      data_source="disk", data_dir=str(tmp_path))
+    params, report = train_victim(cfg, log=lambda *a: None)
+    assert report["steps"] == 96 // 48
+    assert report["n_train"] == 96 and report["n_test"] == 32
+
+
 def test_procedural_rejects_unlearnable_class_counts():
     """>20 classes would collapse neighboring orientation buckets into the
     angle jitter (and imagenet would allocate ~60 GB): refuse loudly."""

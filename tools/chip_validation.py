@@ -15,7 +15,7 @@ time — the tunnel's device grant is exclusive):
                                    step-7 checkpoint, full 2-stage protocol
                                    + 4-radius certification
 
-Results land in artifacts/chip_validation_r04.json as they complete, so a
+Results land in artifacts/chip_validation_r05.json as they complete, so a
 tunnel outage mid-sequence loses nothing. Usage:
 
   python tools/chip_validation.py [--only 1,2,...] [--out PATH]
@@ -34,6 +34,10 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# One absolute victim dir shared by step 7 (--out), step 8 (--model_dir) and
+# the step-8 checkpoint guard, so an out-of-repo invocation can't train into
+# one tree while the guard checks (or step 8 consumes) another.
+VICTIM_DIR = os.path.join(ROOT, "artifacts", "victim_r05")
 
 
 def run(cmd, env_extra, timeout_s):
@@ -116,7 +120,7 @@ STEPS = {
     "7_train_victim": lambda t: (
         parse_train,
         run([sys.executable, "-m", "dorpatch_tpu.train",
-             "--out", "artifacts/victim_r04", "--epochs", "12"], {}, t)),
+             "--out", VICTIM_DIR, "--epochs", "12"], {}, t)),
     "8_flagship_trained": lambda t: (
         parse_flagship,
         run([sys.executable, "-m", "dorpatch_tpu.cli",
@@ -124,8 +128,9 @@ STEPS = {
              "--base_arch", "resnet18", "--img-size", "32", "-b", "8",
              "--num-batches", "2", "--sampling-size", "128",
              "--max-iterations", "600", "--compute-dtype", "bfloat16",
-             "--model_dir", "artifacts/victim_r04",
-             "--results-root", "artifacts/flagship_r04"], {}, t)),
+             "--model_dir", VICTIM_DIR,
+             "--results-root", os.path.join(ROOT, "artifacts",
+                                            "flagship_r05")], {}, t)),
 }
 
 
@@ -155,7 +160,7 @@ def main():
                    help="comma list of step prefixes (e.g. 1,2)")
     p.add_argument("--out",
                    default=os.path.join(ROOT, "artifacts",
-                                        "chip_validation_r04.json"))
+                                        "chip_validation_r05.json"))
     p.add_argument("--timeout", type=int, default=2700,
                    help="per-step deadline (Mosaic compiles through the "
                         "tunnel can take many minutes)")
@@ -178,10 +183,10 @@ def main():
             # the flagship is only meaningful against the step-7 victim: a
             # failed/timed-out training must not burn 45 min of the
             # exclusive device grant against a missing checkpoint, nor
-            # silently consume a stale artifacts/victim_r04 from an
+            # silently consume a stale VICTIM_DIR checkpoint from an
             # earlier round and mislabel the row as "trained-victim"
             trained = (results.get("7_train_victim") or {}).get("parsed")
-            ckpt = os.path.join(ROOT, "artifacts", "victim_r04", "cifar10",
+            ckpt = os.path.join(VICTIM_DIR, "cifar10",
                                 "cifar_resnet18_cutout2_128_cifar10.pth")
             if not trained or not os.path.exists(ckpt):
                 results[name] = {"parsed": None, "rc": None, "seconds": 0,

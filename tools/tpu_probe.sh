@@ -1,0 +1,18 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every 5 minutes; log transitions. Exits 0 the
+# first time a non-cpu jax backend initializes. rc must be the python
+# status (PIPESTATUS[0]), not the pipe tail's, and the match must be
+# affirmative: a crashed probe's traceback tail contains no "cpu" either.
+LOG=/root/repo/artifacts/tpu_probe.log
+mkdir -p /root/repo/artifacts
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 240 python -c "import jax; ds=jax.devices(); print('platform=' + ds[0].platform, len(ds))" 2>&1 | grep "^platform=" | tail -1)
+  rc=${PIPESTATUS[0]}
+  echo "$ts rc=$rc $out" >> "$LOG"
+  if [ "$rc" -eq 0 ] && [[ "$out" == platform=* ]] && [[ "$out" != *cpu* ]]; then
+    echo "$ts TPU_UP" >> "$LOG"
+    exit 0
+  fi
+  sleep 240
+done
