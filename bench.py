@@ -532,10 +532,16 @@ def main() -> None:
         # and an unrelated crash would meet the same fate again.
         log("jax child hit a kernel-signature crash with BENCH_GN=auto; "
             "retrying with flax GN")
-        res, _, _ = spawn("jax", jax_timeout,
-                          cpu_reserve + torch_reserve, {"BENCH_GN": "flax"})
+        res, why, tail = spawn("jax", jax_timeout,
+                               cpu_reserve + torch_reserve,
+                               {"BENCH_GN": "flax"})
         if res is not None:
             gn_fallback = "flax"
+        else:
+            # the retry can fail for a DIFFERENT reason (e.g. the
+            # accelerator wedged between children): fallback_cause must
+            # name what actually killed the last attempt, not the first
+            failure = classify_failure(why, tail)
     if res is None:
         # Accelerator unreachable/wedged: CPU + small victim, so the driver
         # still gets a self-consistent (same-model) ratio row.

@@ -134,6 +134,31 @@ def test_gn_crash_retries_flax_and_tags_row(monkeypatch, capsys):
     assert len(jax_calls) == 2 and jax_calls[1][1]["BENCH_GN"] == "flax"
 
 
+def test_fallback_cause_names_the_last_failure(monkeypatch, capsys):
+    """Kernel crash -> flax retry -> retry TIMES OUT: the fallback row's
+    cause must be the retry's timeout, not the first child's kernel crash
+    (a reader would otherwise chase a kernel regression when the
+    accelerator was simply wedged)."""
+    for var in ("BENCH_MODE", "BENCH_GN", "BENCH_REMAT_POLICY", "BENCH_EOT",
+                "BENCH_IMG", "BENCH_ARCH", "BENCH_TOTAL_BUDGET"):
+        monkeypatch.delenv(var, raising=False)
+
+    def stub(role, timeout_s, env_extra):
+        if role == "torch":
+            return {"ips": 1.0}, None, ""
+        if env_extra.get("JAX_PLATFORMS") == "cpu":
+            return {"ips": 3.0, "batch": 2}, None, ""
+        if env_extra.get("BENCH_GN") == "flax":
+            return None, "timeout", ""
+        return None, "crash", "INTERNAL: Mosaic failed to compile kernel"
+
+    monkeypatch.setattr(bench, "run_child", stub)
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["fallback"] == "cpu"
+    assert rec["fallback_cause"] == "timeout"
+
+
 # --------------------------------------------- r04: outage-proofing (VERDICT
 # round-3 weak #1: a dead-tunnel child was classified as a kernel crash and
 # the flax retry burned the driver's whole budget before the CPU fallback)

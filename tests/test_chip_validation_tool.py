@@ -1,0 +1,146 @@
+"""tools/chip_validation.py resume + guard mechanics. These guard real
+device-grant time: a re-run must not re-spend completed steps, a timed-out
+step plus a dead tunnel must stop the sequence, and step 8 must never run
+against a missing/stale victim checkpoint. All subprocess spawns are
+stubbed — no jax, no device."""
+
+import importlib.util
+import json
+import os
+import sys
+
+_spec = importlib.util.spec_from_file_location(
+    "chip_validation",
+    os.path.join(os.path.dirname(__file__), "..", "tools",
+                 "chip_validation.py"))
+cv = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(cv)
+
+
+def _run_main(monkeypatch, tmp_path, argv, existing=None):
+    out = tmp_path / "cv.json"
+    if existing is not None:
+        out.write_text(json.dumps(existing))
+    monkeypatch.setattr(sys, "argv",
+                        ["chip_validation.py", "--out", str(out)] + argv)
+    rc = cv.main()
+    return rc, json.loads(out.read_text()) if out.exists() else None
+
+
+def test_resume_skips_parsed_steps(monkeypatch, tmp_path):
+    calls = []
+    monkeypatch.setitem(
+        cv.STEPS, "1_gn_microbench",
+        lambda t: (lambda res: {"fresh": True},
+                   calls.append("1") or {"rc": 0, "seconds": 1.0,
+                                         "stdout": "", "stderr": ""}))
+    existing = {"1_gn_microbench": {"parsed": {"gn_fwd_only": 1.0},
+                                    "rc": 0, "seconds": 5.0}}
+    rc, results = _run_main(monkeypatch, tmp_path, ["--only", "1"],
+                            existing=existing)
+    assert rc == 0 and calls == []  # completed step not re-spent
+    assert results["1_gn_microbench"]["parsed"] == {"gn_fwd_only": 1.0}
+
+    rc, results = _run_main(monkeypatch, tmp_path,
+                            ["--only", "1", "--redo", "1"], existing=existing)
+    assert rc == 0 and calls == ["1"]  # --redo forces the re-run
+    assert results["1_gn_microbench"]["parsed"] == {"fresh": True}
+
+
+def test_failed_step_is_retried_on_resume(monkeypatch, tmp_path):
+    """Only steps with a parsed result are skipped: a timeout/crash row
+    (parsed=None) re-runs, which is the whole point of resuming."""
+    calls = []
+    monkeypatch.setitem(
+        cv.STEPS, "1_gn_microbench",
+        lambda t: (lambda res: {"ok": 1},
+                   calls.append("1") or {"rc": 0, "seconds": 1.0,
+                                         "stdout": "", "stderr": ""}))
+    existing = {"1_gn_microbench": {"parsed": None, "rc": None,
+                                    "error": "timeout after 2700s"}}
+    rc, results = _run_main(monkeypatch, tmp_path, ["--only", "1"],
+                            existing=existing)
+    assert rc == 0 and calls == ["1"]
+    assert results["1_gn_microbench"]["parsed"] == {"ok": 1}
+
+
+def test_timeout_plus_dead_tunnel_circuit_breaks(monkeypatch, tmp_path):
+    ran = []
+
+    def timed_out_step(t):
+        ran.append("2")
+        return (cv.parse_bench,
+                {"rc": None, "seconds": float(t), "stdout": "", "stderr": "",
+                 "error": f"timeout after {t}s"})
+
+    monkeypatch.setitem(cv.STEPS, "2_attack_auto_gn", timed_out_step)
+    monkeypatch.setitem(
+        cv.STEPS, "4_certify",
+        lambda t: (cv.parse_bench,
+                   ran.append("4") or {"rc": 0, "seconds": 1.0,
+                                       "stdout": "{}", "stderr": ""}))
+    monkeypatch.setattr(cv, "probe_tunnel", lambda timeout_s=180: False)
+    rc, results = _run_main(monkeypatch, tmp_path, ["--only", "2,4"])
+    assert rc == 3            # stopped resumably...
+    assert ran == ["2"]       # ...before burning step 4's deadline
+    assert "timeout" in results["2_attack_auto_gn"]["error"]
+    assert "4_certify" not in results
+
+
+def test_timeout_with_live_tunnel_continues(monkeypatch, tmp_path):
+    ran = []
+
+    def timed_out_step(t):
+        ran.append("2")
+        return (cv.parse_bench,
+                {"rc": None, "seconds": float(t), "stdout": "", "stderr": "",
+                 "error": f"timeout after {t}s"})
+
+    monkeypatch.setitem(cv.STEPS, "2_attack_auto_gn", timed_out_step)
+    monkeypatch.setitem(
+        cv.STEPS, "4_certify",
+        lambda t: (lambda res: {"ips": 2.0},
+                   ran.append("4") or {"rc": 0, "seconds": 1.0,
+                                       "stdout": "", "stderr": ""}))
+    monkeypatch.setattr(cv, "probe_tunnel", lambda timeout_s=180: True)
+    rc, results = _run_main(monkeypatch, tmp_path, ["--only", "2,4"])
+    assert rc == 0 and ran == ["2", "4"]  # one wedged step, sequence goes on
+    assert results["4_certify"]["parsed"] == {"ips": 2.0}
+
+
+def test_parse_bench_rejects_error_rows():
+    """bench.py delivers rc=0 error rows by design ('benchmark could not
+    run'); banking one as a parsed result would mark the step done and the
+    resume would never retry it (found by driving the real tool against
+    the dead tunnel)."""
+    err_row = ('{"metric": "patch-opt images/sec", "value": 0.0, '
+               '"unit": "images/sec", "vs_baseline": 0.0, '
+               '"error": "benchmark could not run"}')
+    assert cv.parse_bench({"rc": 0, "stdout": err_row}) is None
+    ok_row = '{"metric": "m", "value": 5.0, "vs_baseline": 2.0}'
+    assert cv.parse_bench({"rc": 0, "stdout": ok_row})["value"] == 5.0
+
+
+def test_resume_does_not_bank_cpu_fallback_rows(monkeypatch, tmp_path):
+    """A CPU-fallback bench row is a liveness artifact: once the tunnel
+    holds, the unattended watcher must re-run that step for the on-chip
+    number instead of skipping it as 'already done'."""
+    calls = []
+    monkeypatch.setitem(
+        cv.STEPS, "2_attack_auto_gn",
+        lambda t: (lambda res: {"value": 99.0},
+                   calls.append("2") or {"rc": 0, "seconds": 1.0,
+                                         "stdout": "", "stderr": ""}))
+    existing = {"2_attack_auto_gn": {
+        "parsed": {"value": 0.7, "fallback": "cpu", "comparable": False},
+        "rc": 0, "seconds": 300.0}}
+    rc, results = _run_main(monkeypatch, tmp_path, ["--only", "2"],
+                            existing=existing)
+    assert rc == 0 and calls == ["2"]
+    assert results["2_attack_auto_gn"]["parsed"] == {"value": 99.0}
+
+
+def test_flagship_guard_requires_trained_checkpoint(monkeypatch, tmp_path):
+    rc, results = _run_main(monkeypatch, tmp_path, ["--only", "8"])
+    assert rc == 0
+    assert "skipped" in results["8_flagship_trained"]["error"]

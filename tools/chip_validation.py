@@ -70,16 +70,52 @@ def run(cmd, env_extra, timeout_s):
                 "error": f"timeout after {timeout_s}s"}
 
 
+def probe_tunnel(timeout_s: int = 180) -> bool:
+    """One cheap child: can jax initialize a non-cpu backend right now?
+
+    A dead axon tunnel makes `jax.devices()` HANG (not fail fast), so the
+    timeout is the signal. Called only after a step times out, to tell
+    "this step wedged" from "the tunnel is gone" — the latter means every
+    remaining step would burn its full deadline for nothing (the r03
+    failure shape, 6h of timeouts)."""
+    r = run([sys.executable, "-c",
+             "import jax; ds = jax.devices(); "
+             "assert ds[0].platform != 'cpu', ds; print('tunnel-ok')"],
+            {}, timeout_s)
+    return r.get("rc") == 0 and "tunnel-ok" in r.get("stdout", "")
+
+
 def parse_bench(res):
     if res.get("rc") == 0:
         for line in reversed(res.get("stdout", "").splitlines()):
             line = line.strip()
             if line.startswith("{"):
                 try:
-                    return json.loads(line)
+                    row = json.loads(line)
                 except Exception:
-                    pass
+                    continue
+                # bench delivers rc=0 error rows by design ("benchmark
+                # could not run"): that is a failed step here, not a
+                # result to bank — resume must retry it
+                if row.get("error"):
+                    return None
+                return row
     return None
+
+
+def is_on_chip_result(parsed) -> bool:
+    """True if a stored parsed result is worth skipping on resume.
+
+    A CPU-fallback bench row (fallback/comparable markers) is a liveness
+    artifact, not the on-chip measurement this sequence exists to capture:
+    resuming once the tunnel holds must re-run such steps, or the
+    unattended watcher would bank fallback rows as finished steps."""
+    if parsed is None:
+        return False
+    if isinstance(parsed, dict) and (
+            parsed.get("fallback") or parsed.get("comparable") is False):
+        return False
+    return True
 
 
 def parse_profile_gn(res):
@@ -164,8 +200,12 @@ def main():
     p.add_argument("--timeout", type=int, default=2700,
                    help="per-step deadline (Mosaic compiles through the "
                         "tunnel can take many minutes)")
+    p.add_argument("--redo", default="",
+                   help="comma list of step prefixes to re-run even if the "
+                        "existing --out already has a parsed result")
     args = p.parse_args()
     only = set(args.only.split(",")) if args.only else None
+    redo = set(args.redo.split(",")) if args.redo else set()
 
     results = {}
     if os.path.exists(args.out):
@@ -178,6 +218,13 @@ def main():
 
     for name, step in STEPS.items():
         if only is not None and name.split("_")[0] not in only:
+            continue
+        if (name.split("_")[0] not in redo
+                and is_on_chip_result((results.get(name) or {}).get("parsed"))):
+            # true resume: a completed step's device time is not re-spent
+            # (the loaded results already hold its parsed row)
+            print(json.dumps({name: "already done (use --redo to re-run)"}),
+                  flush=True)
             continue
         if name == "8_flagship_trained":
             # the flagship is only meaningful against the step-7 victim: a
@@ -215,9 +262,19 @@ def main():
             json.dump(results, f, indent=1)
         os.replace(tmp, args.out)  # atomic: an interrupt never truncates
         print(json.dumps({name: results[name].get("parsed")}), flush=True)
+        if res.get("error", "").startswith("timeout") and not probe_tunnel():
+            # Circuit breaker: a step deadline plus a failed 3-min probe
+            # means the tunnel is gone, and every remaining step would eat
+            # its full deadline for nothing. Stop resumably instead; the
+            # skip-completed logic above makes the re-run cheap.
+            print(f"tunnel down after {name}: stopping (resume with the "
+                  f"same --out once tools/tpu_probe.sh reports TPU_UP)",
+                  flush=True)
+            return 3
 
     print(f"results -> {args.out}", flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Wait for the axon tunnel (tpu_probe.sh exits 0 on TPU_UP), then run the
+# resumable on-chip validation sequence — and RE-ARM on a circuit-breaker
+# stop (rc=3: the tunnel died mid-sequence), so a flapping tunnel still
+# completes all steps unattended. Completed steps are skipped on resume
+# (CPU-fallback rows are not banked as completed — see
+# chip_validation.is_on_chip_result). Any other exit code ends the watch.
+cd "$(dirname "$0")/.."
+LOG=artifacts/chip_validation_r05.log
+while true; do
+  bash tools/tpu_probe.sh || { echo "chip_watch: probe loop exited $?" >> "$LOG"; exit 1; }
+  python tools/chip_validation.py >> "$LOG" 2>&1
+  rc=$?
+  echo "chip_watch: chip_validation exited rc=$rc" >> "$LOG"
+  if [ "$rc" -ne 3 ]; then
+    exit "$rc"
+  fi
+  echo "chip_watch: tunnel died mid-sequence; re-arming probe" >> "$LOG"
+done

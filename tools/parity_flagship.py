@@ -40,7 +40,8 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def flagship_config(results_root: str, backend: str):
+def flagship_config(results_root: str, backend: str,
+                    model_dir: str = ""):
     """The chip_validation step-8 flagship config, torch-oracle variant."""
     from dorpatch_tpu.config import AttackConfig, ExperimentConfig
 
@@ -51,7 +52,7 @@ def flagship_config(results_root: str, backend: str):
         batch_size=8,
         num_batches=2,
         data_source="procedural",
-        model_dir=os.path.join(ROOT, "artifacts", "victim_r05"),
+        model_dir=model_dir or os.path.join(ROOT, "artifacts", "victim_r05"),
         results_root=results_root,
         backend=backend,
         attack=AttackConfig(sampling_size=128, max_iterations=600,
@@ -120,6 +121,9 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--jax-root",
                    default=os.path.join(ROOT, "artifacts", "flagship_r05"))
+    p.add_argument("--model-dir", default="",
+                   help="victim checkpoint dir; must be the SAME dir the "
+                        "jax flagship used (default artifacts/victim_r05)")
     p.add_argument("--attack", action="store_true",
                    help="also run the independent torch attack (slow: the "
                         "full two-stage optimization on CPU)")
@@ -148,7 +152,7 @@ def main(argv=None) -> int:
     if staged == 0:
         print(f"no patch artifacts under {args.jax_root}", file=sys.stderr)
         return 1
-    cert_cfg = flagship_config(oracle_root, "torch")
+    cert_cfg = flagship_config(oracle_root, "torch", args.model_dir)
     torch_cert = run_experiment(cert_cfg, verbose=True)
 
     out = {
@@ -168,7 +172,8 @@ def main(argv=None) -> int:
     # Leg 2 (optional): independent torch attack, own artifact tree.
     if args.attack:
         atk_cfg = flagship_config(
-            os.path.join(ROOT, "artifacts", "flagship_r05_torch"), "torch")
+            os.path.join(ROOT, "artifacts", "flagship_r05_torch"), "torch",
+            args.model_dir)
         torch_atk = run_experiment(atk_cfg, verbose=True)
         out["oracle_attack"] = {
             "rows": parity_rows(jax_m, torch_atk),

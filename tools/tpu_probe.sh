@@ -1,14 +1,18 @@
 #!/bin/bash
 # Probe the axon TPU tunnel every 5 minutes; log transitions. Exits 0 the
-# first time a non-cpu jax backend initializes. rc must be the python
-# status (PIPESTATUS[0]), not the pipe tail's, and the match must be
-# affirmative: a crashed probe's traceback tail contains no "cpu" either.
+# first time a non-cpu jax backend initializes. The python status is
+# captured directly (no pipe: PIPESTATUS inside $() is lost to the parent
+# shell), and the match is affirmative: a crashed probe's traceback tail
+# contains no "cpu" either, so only an explicit platform= line counts.
 LOG=/root/repo/artifacts/tpu_probe.log
 mkdir -p /root/repo/artifacts
+TMP=$(mktemp)
+trap 'rm -f "$TMP"' EXIT
 while true; do
   ts=$(date -u +%FT%TZ)
-  out=$(timeout 240 python -c "import jax; ds=jax.devices(); print('platform=' + ds[0].platform, len(ds))" 2>&1 | grep "^platform=" | tail -1)
-  rc=${PIPESTATUS[0]}
+  timeout 240 python -c "import jax; ds = jax.devices(); print('platform=' + ds[0].platform, len(ds))" > "$TMP" 2>&1
+  rc=$?
+  out=$(grep "^platform=" "$TMP" | tail -1)
   echo "$ts rc=$rc $out" >> "$LOG"
   if [ "$rc" -eq 0 ] && [[ "$out" == platform=* ]] && [[ "$out" != *cpu* ]]; then
     echo "$ts TPU_UP" >> "$LOG"
