@@ -150,11 +150,15 @@ def child_jax() -> None:
     import jax
     import jax.numpy as jnp
 
-    from dorpatch_tpu import losses
+    from dorpatch_tpu import losses, utils
     from dorpatch_tpu import masks as masks_lib
     from dorpatch_tpu.attack import DorPatch
     from dorpatch_tpu.config import AttackConfig
     from dorpatch_tpu.models import get_model
+
+    # repeated bench children recompile the same programs; through the
+    # tunnel that is minutes each — share one persistent XLA cache
+    utils.enable_compilation_cache()
 
     dataset = os.environ.get("BENCH_DATASET", "imagenet")
     arch = os.environ.get("BENCH_ARCH", "resnetv2")
@@ -247,6 +251,7 @@ def child_jax() -> None:
         return {
             "ips": batch / step_seconds,
             "batch": batch,
+            "backend": jax.default_backend(),
             "remat": remat,
             "mfu": round(mfu, 4) if mfu is not None else None,
             "step_seconds": round(step_seconds, 4),
@@ -325,6 +330,7 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
     print(json.dumps({
         "ips": batch / dt,
         "batch": batch,
+        "backend": jax.default_backend(),
         "masks_per_image": int(n_masks),
         "masked_fwd_per_sec": round(batch * n_masks / dt, 1),
         "seconds_per_batch": round(dt, 4),
@@ -589,7 +595,7 @@ def main() -> None:
         out["mfu"] = res["mfu"]
     for k in ("remat", "step_seconds", "fwd_gflops_per_image", "batch",
               "masked_images_per_sec", "masks_per_image", "masked_fwd_per_sec",
-              "seconds_per_batch"):
+              "seconds_per_batch", "backend"):
         if res.get(k) is not None:
             out[k] = res[k]
     if fallback is not None:

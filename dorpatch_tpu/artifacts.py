@@ -42,6 +42,23 @@ def results_path(cfg: ExperimentConfig) -> str:
     return os.path.join(cfg.results_root, top, sub)
 
 
+def write_config_record(cfg: ExperimentConfig, result_dir: str) -> None:
+    """Persist the exact experiment config beside the artifacts
+    (`config.json`), so a results tree is self-describing — `results_path`
+    encodes only a few attack knobs; sampling_size / max_iterations / seed
+    would otherwise be unrecoverable — and the parity tool can reconstruct
+    the torch-oracle config from the jax run it is scoring."""
+    import json
+
+    from dorpatch_tpu.config import config_to_dict
+
+    try:
+        with open(os.path.join(result_dir, "config.json"), "w") as fh:
+            json.dump(config_to_dict(cfg), fh, indent=1, default=float)
+    except OSError:
+        pass  # read-only results dir: artifacts still work without it
+
+
 def _to_torch_nchw(arr: np.ndarray):
     import torch
 

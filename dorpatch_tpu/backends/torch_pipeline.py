@@ -18,7 +18,7 @@ import numpy as np
 import torch
 
 from dorpatch_tpu import metrics
-from dorpatch_tpu.artifacts import ArtifactStore, results_path
+from dorpatch_tpu.artifacts import ArtifactStore, results_path, write_config_record
 from dorpatch_tpu.backends.torch_attack import (
     TorchDorPatch,
     build_torch_defenses,
@@ -64,6 +64,7 @@ def run_experiment_torch(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
 
     model = get_torch_victim(cfg)
     store = ArtifactStore(results_path(cfg))
+    write_config_record(cfg, store.result_dir)
     defenses = build_torch_defenses(model, cfg.img_size, cfg.defense)
     attack = TorchDorPatch(model, cfg.num_classes, cfg.attack)
 

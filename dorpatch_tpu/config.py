@@ -99,6 +99,35 @@ class DefenseConfig:
     use_pallas: str = "auto"        # fused mask-fill kernel: auto|on|off|interpret
 
 
+def config_to_dict(cfg: "ExperimentConfig") -> dict:
+    """JSON-safe nested dict of the full experiment config (reproducibility
+    record written beside summary.json by the pipelines)."""
+    return dataclasses.asdict(cfg)
+
+
+def config_from_dict(d: dict) -> "ExperimentConfig":
+    """Inverse of `config_to_dict`. Unknown keys are rejected (a config
+    written by a newer code version must not silently lose knobs); list
+    values round-trip back to the tuples the dataclasses declare."""
+    def build(cls, sub: dict):
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        unknown = set(sub) - set(fields)
+        if unknown:
+            raise ValueError(f"unknown {cls.__name__} keys: {sorted(unknown)}")
+        kw = {}
+        for k, v in sub.items():
+            if isinstance(v, list):
+                v = tuple(v)
+            kw[k] = v
+        return cls(**kw)
+
+    d = dict(d)
+    attack = build(AttackConfig, d.pop("attack", {}))
+    defense = build(DefenseConfig, d.pop("defense", {}))
+    cfg = build(ExperimentConfig, d)
+    return dataclasses.replace(cfg, attack=attack, defense=defense)
+
+
 def resolved_data_source(cfg: "ExperimentConfig") -> str:
     """cfg.data_source with "auto" mapped through the synthetic_data flag.
 

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dorpatch_tpu import losses, metrics, observe, parallel, utils
-from dorpatch_tpu.artifacts import ArtifactStore, results_path
+from dorpatch_tpu.artifacts import ArtifactStore, results_path, write_config_record
 from dorpatch_tpu.attack import DorPatch
 from dorpatch_tpu.config import ExperimentConfig, resolved_data_source
 from dorpatch_tpu.data import dataset_batches
@@ -61,10 +61,17 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             "the attack/defense APIs directly")
     utils.set_global_seed(cfg.seed)       # host RNGs (`utils.py:16-21`)
     utils.select_device(cfg.device)       # `--device` flag (`utils.py:12-13`)
+    utils.enable_compilation_cache()      # re-runs skip tunnel recompiles
+    if verbose:
+        # lets log consumers (chip_validation) tell a real accelerator run
+        # from jax silently falling back to the CPU backend
+        print(f"backend: {jax.default_backend()} "
+              f"({len(jax.devices())} devices)", flush=True)
     rng = np.random.default_rng(cfg.seed)
     victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size,
                        gn_impl=cfg.gn_impl)
     store = ArtifactStore(results_path(cfg))
+    write_config_record(cfg, store.result_dir)
     logger = observe.AttackMetricsLogger(
         path=os.path.join(store.result_dir, "metrics.jsonl") if cfg.metrics_log else None,
         echo_every=cfg.attack.report_interval if verbose else 0,

@@ -81,7 +81,10 @@ def train_victim(cfg: TrainConfig = TrainConfig(), log=print) -> Tuple[dict, dic
     import optax
 
     from dorpatch_tpu import data as data_lib
+    from dorpatch_tpu import utils
     from dorpatch_tpu.models.small import CifarResNet18
+
+    utils.enable_compilation_cache()
 
     tr_x, tr_y = data_lib.training_arrays(
         cfg.dataset, cfg.data_source, cfg.data_dir,

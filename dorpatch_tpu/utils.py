@@ -12,11 +12,30 @@ reference's integer device flag onto the jax device list.
 
 from __future__ import annotations
 
+import os
 import random
 from typing import Optional
 
 import jax
 import numpy as np
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
+    """Point XLA's persistent compilation cache at a shared on-disk dir.
+
+    Compiles through the remote TPU tunnel cost minutes for Pallas-heavy
+    programs; the cache keys on the optimized HLO + backend, so the repeated
+    jobs this repo runs (bench children, chip-validation steps, the driver's
+    round-end bench) pay that once. `JAX_COMPILATION_CACHE_DIR` in the env
+    wins; the default lives outside the repo so artifacts/ stays textual.
+    Safe to call repeatedly; returns the directory in effect."""
+    d = (cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+         or os.path.join(os.path.expanduser("~"), ".cache", "dorpatch_xla"))
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    # default min compile time is ~1 s; keep tiny programs out of the cache
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    return d
 
 
 def set_global_seed(seed: int = 1234) -> jax.Array:

@@ -108,6 +108,45 @@ def test_timeout_with_live_tunnel_continues(monkeypatch, tmp_path):
     assert results["4_certify"]["parsed"] == {"ips": 2.0}
 
 
+def test_parsers_reject_silent_cpu_fallback():
+    """A child that silently landed on the jax CPU backend (dead plugin
+    registration) must not be banked as an on-chip result: profile_gn and
+    the pipeline announce `backend: <name>`, train.py reports
+    `'backend': '<name>'` — all three parsers key off it."""
+    gn_ok = ("backend: axon\n"
+             "[gn] fwd-only scan  12.5 ms/iter\n"
+             "[fused] fwd-only scan  9.1 ms/iter\n")
+    assert cv.parse_profile_gn({"rc": 0, "stdout": gn_ok}) == {
+        "gn_fwd-only": 12.5, "fused_fwd-only": 9.1}
+    gn_cpu = gn_ok.replace("backend: axon", "backend: cpu")
+    assert cv.parse_profile_gn({"rc": 0, "stdout": gn_cpu}) is None
+
+    train_ok = ("epoch 12/12: train_acc=0.99 (300s)\n"
+                "saved /x/v.pth; report={'test_acc': 0.97, "
+                "'backend': 'axon'}\n")
+    assert cv.parse_train({"rc": 0, "stdout": train_ok}) is not None
+    train_cpu = train_ok.replace("'axon'", "'cpu'")
+    assert cv.parse_train({"rc": 0, "stdout": train_cpu}) is None
+
+    flag_ok = ("backend: axon (1 devices)\n"
+               "clean accuracy: 97.00%, ... certified_ASR@PC:0.00%\n")
+    assert cv.parse_flagship({"rc": 0, "stdout": flag_ok}) is not None
+    flag_cpu = flag_ok.replace("backend: axon", "backend: cpu")
+    assert cv.parse_flagship({"rc": 0, "stdout": flag_cpu}) is None
+
+
+def test_is_on_chip_result_rejects_unmarked_cpu_backend_rows():
+    """bench rows now carry the child's jax backend: a row from a child
+    that silently landed on CPU (no fallback marker, plugin registered but
+    device gone) must not be banked either."""
+    assert cv.is_on_chip_result({"value": 50.0, "backend": "axon"})
+    assert not cv.is_on_chip_result({"value": 0.7, "backend": "cpu"})
+    assert not cv.is_on_chip_result({"value": 0.7, "fallback": "cpu"})
+    assert not cv.is_on_chip_result(None)
+    # pre-r05 rows without the key keep working (skippable when parsed)
+    assert cv.is_on_chip_result({"value": 50.0})
+
+
 def test_parse_bench_rejects_error_rows():
     """bench.py delivers rc=0 error rows by design ('benchmark could not
     run'); banking one as a parsed result would mark the step done and the

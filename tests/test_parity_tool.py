@@ -56,6 +56,65 @@ def test_parity_rows_and_delta():
     assert max(asr_deltas) == 1.5
 
 
+def test_flagship_config_reconstructs_from_recorded_config(tmp_path):
+    """A jax tree carrying the r05 config.json record must drive the oracle
+    at the SAME scale it ran (e.g. a CPU-scaled hedge), not the hardcoded
+    step-8 flags — with backend/results_root flipped and fp32 forced."""
+    import dataclasses
+    import json
+
+    from dorpatch_tpu.config import AttackConfig, ExperimentConfig, config_to_dict
+
+    ran = ExperimentConfig(
+        dataset="cifar10", base_arch="resnet18", img_size=32, batch_size=4,
+        num_batches=1, data_source="procedural", seed=77,
+        model_dir="/victims/x", results_root=str(tmp_path / "jaxroot"),
+        attack=AttackConfig(sampling_size=16, max_iterations=150,
+                            compute_dtype="bfloat16"))
+    sub = tmp_path / "jaxroot" / "cfg" / "sub"
+    sub.mkdir(parents=True)
+    cfg_path = sub / "config.json"
+    cfg_path.write_text(json.dumps(config_to_dict(ran)))
+
+    cfg = parity.flagship_config(str(tmp_path / "oracle"), "torch",
+                                 config_path=str(cfg_path))
+    assert cfg.backend == "torch"
+    assert cfg.results_root == str(tmp_path / "oracle")
+    assert cfg.seed == 77 and cfg.batch_size == 4 and cfg.num_batches == 1
+    assert cfg.attack.sampling_size == 16
+    assert cfg.attack.max_iterations == 150
+    assert cfg.attack.compute_dtype == "float32"  # oracle is fp32
+    assert cfg.model_dir == "/victims/x"
+    # explicit --model-dir still wins over the record
+    cfg2 = parity.flagship_config(str(tmp_path / "oracle"), "torch",
+                                  model_dir="/other",
+                                  config_path=str(cfg_path))
+    assert cfg2.model_dir == "/other"
+    # a missing record falls back to the step-8 flags, not a crash
+    cfg3 = parity.flagship_config(str(tmp_path / "oracle"), "torch",
+                                  config_path=str(sub / "absent.json"))
+    assert cfg3.attack.max_iterations == 600
+
+
+def test_config_record_round_trip():
+    import dataclasses
+
+    from dorpatch_tpu.config import (AttackConfig, DefenseConfig,
+                                     ExperimentConfig, config_from_dict,
+                                     config_to_dict)
+
+    cfg = ExperimentConfig(
+        dataset="cifar100", img_size=64, seed=9,
+        attack=AttackConfig(dropout_sizes=(0.03, 0.12), targeted=True),
+        defense=DefenseConfig(ratios=(0.06,), n_patch=2))
+    back = config_from_dict(config_to_dict(cfg))
+    assert back == cfg  # frozen dataclasses: full structural equality
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown"):
+        config_from_dict({**config_to_dict(cfg), "not_a_knob": 1})
+
+
 def test_flagship_config_matches_chip_validation_step8():
     """The oracle must score the SAME protocol chip_validation step 8 ran:
     drift here silently breaks the 'same seeds and images' premise."""

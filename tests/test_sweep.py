@@ -18,6 +18,24 @@ def test_set_global_seed_reproducible():
     np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
 
 
+def test_enable_compilation_cache(tmp_path, monkeypatch):
+    """The persistent-cache helper must honor the env override, create the
+    dir, and leave jax pointed at it (tunnel recompiles cost minutes; every
+    entry point calls this)."""
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        target = tmp_path / "xla_cache"
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(target))
+        got = utils.enable_compilation_cache()
+        assert got == str(target) and target.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(target)
+        explicit = tmp_path / "explicit"
+        assert utils.enable_compilation_cache(str(explicit)) == str(explicit)
+        assert explicit.is_dir()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
 def test_select_device():
     prev = jax.config.jax_default_device
     try:

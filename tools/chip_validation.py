@@ -113,14 +113,29 @@ def is_on_chip_result(parsed) -> bool:
     if parsed is None:
         return False
     if isinstance(parsed, dict) and (
-            parsed.get("fallback") or parsed.get("comparable") is False):
+            parsed.get("fallback") or parsed.get("comparable") is False
+            or parsed.get("backend") == "cpu"):
+        # backend=="cpu": the jax child silently landed on the CPU backend
+        # WITHOUT the orchestrator's fallback path (plugin registered but
+        # device gone) — an unmarked row, same non-measurement
         return False
     return True
+
+
+def ran_on_cpu(res) -> bool:
+    """True if the child announced a jax-CPU backend — a silent fallback
+    that must not be banked as an on-chip result (profile_gn and the
+    pipeline print `backend: <name>`; train.py reports `'backend': '<name>'`
+    in its saved-report dict)."""
+    out = res.get("stdout", "")
+    return ("backend: cpu" in out) or ("'backend': 'cpu'" in out)
 
 
 def parse_profile_gn(res):
     if res.get("rc") != 0:
         return None  # partial rows from a crashed child are not a success
+    if ran_on_cpu(res):
+        return None  # CPU-fallback microbench is not an on-chip measurement
     rows = {}
     for line in res.get("stdout", "").splitlines():
         m = re.match(r"\[(\w+)\] (fwd-only|fwd\+bwd) scan\s+([\d.]+) ms/iter",
@@ -172,7 +187,7 @@ STEPS = {
 
 def parse_train(res):
     """`train.py` prints `saved <path>; report={...}` on success."""
-    if res.get("rc") != 0:
+    if res.get("rc") != 0 or ran_on_cpu(res):
         return None
     for line in reversed(res.get("stdout", "").splitlines()):
         if line.startswith("saved ") and "report=" in line:
@@ -182,7 +197,7 @@ def parse_train(res):
 
 def parse_flagship(res):
     """The pipeline prints the reference-format report line last."""
-    if res.get("rc") != 0:
+    if res.get("rc") != 0 or ran_on_cpu(res):
         return None
     for line in reversed(res.get("stdout", "").splitlines()):
         if "certified_ASR@PC" in line:

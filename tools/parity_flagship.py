@@ -41,10 +41,36 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def flagship_config(results_root: str, backend: str,
-                    model_dir: str = ""):
-    """The chip_validation step-8 flagship config, torch-oracle variant."""
-    from dorpatch_tpu.config import AttackConfig, ExperimentConfig
+                    model_dir: str = "", config_path: str = ""):
+    """The torch-oracle config for the flagship run being scored.
 
+    `config_path` is the config.json sitting in the SAME result dir as the
+    summary.json the caller chose (never globbed independently — jax_root
+    can hold several runs, and pairing a summary with another run's config
+    would silently break the same-seeds-same-images premise). When present
+    (written by the pipelines since r05), the oracle reconstructs THAT
+    config, whatever scale the run used (full step-8 or a CPU-scaled
+    hedge). Fallback: the hardcoded chip_validation step-8 flags, for trees
+    predating the record."""
+    import dataclasses
+
+    from dorpatch_tpu.config import (AttackConfig, ExperimentConfig,
+                                     config_from_dict)
+
+    recorded = None
+    if config_path and os.path.exists(config_path):
+        with open(config_path) as f:
+            recorded = config_from_dict(json.load(f))
+    if recorded is not None:
+        return dataclasses.replace(
+            recorded,
+            backend=backend,
+            results_root=results_root,
+            model_dir=model_dir or recorded.model_dir,
+            # the torch oracle is fp32; bf16 is a jax-path knob
+            attack=dataclasses.replace(recorded.attack,
+                                       compute_dtype="float32"),
+        )
     return ExperimentConfig(
         dataset="cifar10",
         base_arch="resnet18",
@@ -152,7 +178,9 @@ def main(argv=None) -> int:
     if staged == 0:
         print(f"no patch artifacts under {args.jax_root}", file=sys.stderr)
         return 1
-    cert_cfg = flagship_config(oracle_root, "torch", args.model_dir)
+    jax_config_path = os.path.join(os.path.dirname(jax_path), "config.json")
+    cert_cfg = flagship_config(oracle_root, "torch", args.model_dir,
+                               config_path=jax_config_path)
     torch_cert = run_experiment(cert_cfg, verbose=True)
 
     out = {
@@ -173,7 +201,7 @@ def main(argv=None) -> int:
     if args.attack:
         atk_cfg = flagship_config(
             os.path.join(ROOT, "artifacts", "flagship_r05_torch"), "torch",
-            args.model_dir)
+            args.model_dir, config_path=jax_config_path)
         torch_atk = run_experiment(atk_cfg, verbose=True)
         out["oracle_attack"] = {
             "rows": parity_rows(jax_m, torch_atk),

@@ -29,7 +29,13 @@ import time
 import jax
 import jax.numpy as jnp
 
+from dorpatch_tpu import utils
 from dorpatch_tpu.models import resnetv2
+
+utils.enable_compilation_cache()  # tunnel recompiles cost minutes
+# announced so callers (chip_validation) can refuse to bank a silent
+# jax-CPU fallback as an on-chip measurement
+print(f"backend: {jax.default_backend()}", flush=True)
 
 
 def timed_scan(name, fn, args, k, flops_per_iter=None, reps=2):
