@@ -24,3 +24,11 @@ os.environ["XLA_FLAGS"] = " ".join(_flags)
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# The suite is compile-dominated on this one-core machine (~40 min cold):
+# share the persistent XLA cache so re-runs only pay for changed programs.
+# Tests that would be perturbed by caching (none known — keys are HLO-exact)
+# can override with their own config.
+from dorpatch_tpu.utils import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()

@@ -135,6 +135,30 @@ def test_parsers_reject_silent_cpu_fallback():
     assert cv.parse_flagship({"rc": 0, "stdout": flag_cpu}) is None
 
 
+def test_cpu_marker_survives_stdout_truncation():
+    """The flagship child prints `backend: cpu` ONCE at the start, then
+    ~18 KB of metrics echoes: run() records the marker from the FULL
+    stdout before keeping only the 4 KB tail, and ran_on_cpu prefers
+    that record over re-scanning the (truncated) tail."""
+    noise = "x" * 120 + "\n"
+    long_out = "backend: cpu (1 devices)\n" + noise * 200 + \
+        "clean accuracy: 97.00%, ... certified_ASR@PC:0.00%\n"
+    assert "backend: cpu" not in long_out[-4000:]  # truncation would hide it
+    res = {"rc": 0, "cpu_backend": ("backend: cpu" in long_out),
+           "stdout": long_out[-4000:]}
+    assert cv.ran_on_cpu(res)
+    assert cv.parse_flagship(res) is None
+    # and run() itself records the flag: exercise it via a real child
+    r = cv.run([sys.executable, "-c",
+                "print('backend: cpu (8 devices)'); print('y' * 9000)"],
+               {}, 60)
+    assert r["cpu_backend"] is True and len(r["stdout"]) <= 4000
+    r2 = cv.run([sys.executable, "-c",
+                 "print('backend: axon (1 devices)'); print('y' * 9000)"],
+                {}, 60)
+    assert r2["cpu_backend"] is False
+
+
 def test_is_on_chip_result_rejects_unmarked_cpu_backend_rows():
     """bench rows now carry the child's jax backend: a row from a child
     that silently landed on CPU (no fallback marker, plugin registered but

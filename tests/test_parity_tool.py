@@ -60,7 +60,6 @@ def test_flagship_config_reconstructs_from_recorded_config(tmp_path):
     """A jax tree carrying the r05 config.json record must drive the oracle
     at the SAME scale it ran (e.g. a CPU-scaled hedge), not the hardcoded
     step-8 flags — with backend/results_root flipped and fp32 forced."""
-    import dataclasses
     import json
 
     from dorpatch_tpu.config import AttackConfig, ExperimentConfig, config_to_dict
@@ -97,8 +96,6 @@ def test_flagship_config_reconstructs_from_recorded_config(tmp_path):
 
 
 def test_config_record_round_trip():
-    import dataclasses
-
     from dorpatch_tpu.config import (AttackConfig, DefenseConfig,
                                      ExperimentConfig, config_from_dict,
                                      config_to_dict)

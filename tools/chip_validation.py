@@ -51,22 +51,31 @@ def run(cmd, env_extra, timeout_s):
         env["BENCH_TOTAL_BUDGET"] = str(max(120, timeout_s - 120))
     env.update(env_extra)
     t0 = time.time()
+
+    def _cpu_marker(full: str) -> bool:
+        # scanned over the FULL stdout before truncation: a verbose child
+        # (the step-8 flagship echoes ~18 KB of metrics) prints its
+        # `backend:` line once at the start, long before the retained tail
+        return ("backend: cpu" in full) or ("'backend': 'cpu'" in full)
+
     try:
         proc = subprocess.run(
             cmd, env=env, cwd=ROOT, capture_output=True, text=True,
             timeout=timeout_s)
         return {"rc": proc.returncode, "seconds": round(time.time() - t0, 1),
+                "cpu_backend": _cpu_marker(proc.stdout or ""),
                 "stdout": proc.stdout[-4000:], "stderr": proc.stderr[-4000:]}
     except subprocess.TimeoutExpired as e:
-        def _txt(b):
+        def _full(b):
             if b is None:
                 return ""
-            return (b.decode(errors="replace") if isinstance(b, bytes)
-                    else b)[-4000:]
+            return b.decode(errors="replace") if isinstance(b, bytes) else b
         # keep whatever the child printed before the deadline: it is the
         # only way to tell "hung claiming the device" from "hung in compile"
         return {"rc": None, "seconds": round(time.time() - t0, 1),
-                "stdout": _txt(e.stdout), "stderr": _txt(e.stderr),
+                "cpu_backend": _cpu_marker(_full(e.stdout)),
+                "stdout": _full(e.stdout)[-4000:],
+                "stderr": _full(e.stderr)[-4000:],
                 "error": f"timeout after {timeout_s}s"}
 
 
@@ -126,7 +135,11 @@ def ran_on_cpu(res) -> bool:
     """True if the child announced a jax-CPU backend — a silent fallback
     that must not be banked as an on-chip result (profile_gn and the
     pipeline print `backend: <name>`; train.py reports `'backend': '<name>'`
-    in its saved-report dict)."""
+    in its saved-report dict). `run()` scans the FULL child stdout before
+    truncating to the 4 KB tail and records `cpu_backend`; the tail scan
+    remains as the fallback for results recorded by older runs."""
+    if "cpu_backend" in res:
+        return bool(res["cpu_backend"])
     out = res.get("stdout", "")
     return ("backend: cpu" in out) or ("'backend': 'cpu'" in out)
 

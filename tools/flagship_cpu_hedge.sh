@@ -1,0 +1,34 @@
+#!/bin/bash
+# CPU hedge for the trained-victim flagship (VERDICT r04 next #3): when the
+# tunnel is down, produce the full protocol evidence on CPU — trained
+# victim -> two-stage attack on the procedural eval split -> 4-radius
+# certification -> torch-oracle certified-ASR parity. Scaled config
+# (sampling 16, 200 iters) so one CPU core finishes in hours, recorded in
+# the run's config.json so the parity oracle scores the same scale.
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/flagship_cpu_hedge.log
+CKPT=artifacts/victim_r05_cpu/cifar10/cifar_resnet18_cutout2_128_cifar10.pth
+echo "$(date -u +%FT%TZ) hedge: waiting for $CKPT" >> "$LOG"
+for i in $(seq 1 720); do
+  [ -f "$CKPT" ] && break
+  sleep 60
+done
+if [ ! -f "$CKPT" ]; then
+  echo "$(date -u +%FT%TZ) hedge: no checkpoint after 12h; giving up" >> "$LOG"
+  exit 1
+fi
+echo "$(date -u +%FT%TZ) hedge: attacking" >> "$LOG"
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m dorpatch_tpu.cli \
+  --data-source procedural --dataset cifar10 --base_arch resnet18 \
+  --img-size 32 -b 4 --num-batches 2 --sampling-size 16 \
+  --max-iterations 200 --model_dir artifacts/victim_r05_cpu \
+  --results-root artifacts/flagship_r05_cpu >> "$LOG" 2>&1
+rc=$?
+echo "$(date -u +%FT%TZ) hedge: attack rc=$rc" >> "$LOG"
+[ $rc -ne 0 ] && exit $rc
+echo "$(date -u +%FT%TZ) hedge: torch-oracle parity" >> "$LOG"
+python tools/parity_flagship.py --jax-root artifacts/flagship_r05_cpu \
+  --model-dir artifacts/victim_r05_cpu --attack \
+  --out artifacts/PARITY_r05_cpu.json >> "$LOG" 2>&1
+echo "$(date -u +%FT%TZ) hedge: parity rc=$?" >> "$LOG"
