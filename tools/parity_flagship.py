@@ -38,6 +38,7 @@ import os
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)  # runnable from any CWD, like the other tools
 
 
 def flagship_config(results_root: str, backend: str,
