@@ -441,11 +441,25 @@ class DorPatch:
 
     # ---------- jitted block + sweep ----------
 
+    def _out_replicated(self):
+        """out_shardings pin for the per-image program outputs when a mesh
+        is present: the compiler is otherwise free to leave carry fields
+        sharded over the data axis, which a multi-PROCESS driver cannot
+        np.asarray (non-addressable shards). Per-image state is tiny next
+        to the masked batch, so the closing gather is noise — and the HLO
+        guard's no-big-all-gather bound still holds."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec())
+
     def _get_block(self, stage: int, img_size: int, n_steps: int):
         key = (stage, img_size, n_steps)
         if key not in self._programs:
 
-            @partial(jax.jit, static_argnums=())
+            @partial(jax.jit, static_argnums=(),
+                     out_shardings=self._out_replicated())
             def run_block(state, x, local_var_x, universe):
                 def body(s, _):
                     return self._step(s, x, local_var_x, universe, stage), None
@@ -461,7 +475,7 @@ class DorPatch:
         fails if any image's goal is violated under it. Returns bool [n_mask]."""
         if "sweep" not in self._programs:
 
-            @jax.jit
+            @partial(jax.jit, out_shardings=self._out_replicated())
             def sweep(adv_mask, adv_pattern, x, y, targeted, universe):
                 delta = losses.l2_project(adv_mask, adv_pattern, x, self.config.eps)
                 adv_x = x + delta

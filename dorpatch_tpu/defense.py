@@ -258,7 +258,16 @@ class PatchCleanser:
                 p1, p2, self._num_singles, num_classes)
             return pred, certified, p1, p2
 
-        self._predict = jax.jit(_predict, static_argnums=2)
+        out_shardings = None
+        if self.mesh is not None:
+            # replicated outputs: the [B]/[B,M] verdict tables must be
+            # host-addressable on EVERY process of a multi-process run
+            # (robust_predict materializes them with np.asarray)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            out_shardings = NamedSharding(self.mesh, PartitionSpec())
+        self._predict = jax.jit(_predict, static_argnums=2,
+                                out_shardings=out_shardings)
 
     def robust_predict(
         self, params, imgs: jax.Array, num_classes: int

@@ -18,10 +18,12 @@ from dorpatch_tpu.parallel.sharded import (
     make_sharded_attack,
     make_sharded_defenses,
 )
+from dorpatch_tpu.parallel import multiproc
 
 __all__ = [
     "DATA_AXIS",
     "MASK_AXIS",
+    "multiproc",
     "data_sharding",
     "flat_batch_sharding",
     "make_mesh",

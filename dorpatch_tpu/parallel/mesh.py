@@ -134,8 +134,19 @@ def shard_apply_fn(
 
 
 def place_replicated(mesh: Mesh, tree):
-    """Replicate a pytree (model params, mask universe) over the mesh."""
-    return jax.device_put(tree, replicated(mesh))
+    """Replicate a pytree (model params, mask universe) over the mesh.
+
+    On a multi-process mesh `jax.device_put` cannot target non-addressable
+    devices ("cross-host reshard"); `make_array_from_process_local_data`
+    with a fully-replicated sharding is the multi-controller form — every
+    process contributes its (identical) full copy."""
+    sh = replicated(mesh)
+    me = jax.process_index()
+    if all(d.process_index == me for d in mesh.devices.flat):
+        return jax.device_put(tree, sh)
+    return jax.tree_util.tree_map(
+        lambda a: jax.make_array_from_process_local_data(sh, np.asarray(a)),
+        tree)
 
 
 def place_batch(mesh: Mesh, x: jax.Array, *per_image):

@@ -1,0 +1,162 @@
+"""Multi-process experiment-driver support (BASELINE config 5 end-to-end).
+
+The reference's driver is strictly single-process (`torch.nn.DataParallel`
+inside one python, `/root/reference/main.py:53`); multi-host was out of its
+reach entirely. This module makes `pipeline.run_experiment` runnable under
+`jax.distributed` with N processes, the TPU-native way:
+
+**Design: SPMD with replicated host logic.** Every process executes the
+identical host program on identical host values — data generation is
+deterministic from the config seed, target draws use the same seeded rng,
+and per-image driver state (the B<=batch_size images, masks, patterns,
+predictions) is REPLICATED over the global mesh. The wide masked-image
+batch — where all the FLOPs are — still shards over the whole mesh through
+`shard_apply_fn`, so compute scales exactly like the single-process sharded
+path (the data axis participates in sharding the flat masked batch; with
+driver batches of <=8 images, sharding the image axis itself buys nothing).
+Because every host value the driver branches on is identical across
+processes, every process enters every jitted collective in the same order —
+the SPMD contract — and every np.asarray() materializes a fully-addressable
+replicated array.
+
+**Artifact IO is process-0-only, with reads broadcast.** A resume decision
+taken from the filesystem must not diverge (process 0 sees the cache file,
+process 1 doesn't → different jit call sequences → a collective mismatch
+hang). `Process0Store` wraps `ArtifactStore`: writes happen only on process
+0; cache reads happen on process 0 and are broadcast (flag + shapes +
+values) via `multihost_utils.broadcast_one_to_all`, so all processes take
+the same branch with the same data. The pickled PatchCleanser record cache
+is the one exception: records are python objects, so multi-process runs
+recompute certification (cheap next to the attack) while process 0 still
+saves records for later single-process reuse.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+
+
+def is_main() -> bool:
+    """True on the process that owns artifact writes and logging."""
+    return jax.process_index() == 0
+
+
+def _bcast(tree):
+    """Broadcast process 0's pytree of numpy arrays to all processes."""
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
+def _bcast_optional_arrays(values: Optional[Tuple[np.ndarray, ...]],
+                           dtypes) -> Optional[Tuple[np.ndarray, ...]]:
+    """Broadcast a maybe-absent tuple of arrays from process 0.
+
+    Presence and shapes are broadcast first (receivers cannot know either —
+    the cache file only exists on process 0's filesystem), then the values.
+    `dtypes` fixes the dtype per element; receivers allocate zeros."""
+    n = len(dtypes)
+    if is_main() and values is not None:
+        values = tuple(np.asarray(v) for v in values)
+        ranks = [v.ndim for v in values]
+        header = [1] + ranks
+        flat_shapes = [d for v in values for d in v.shape]
+    else:
+        values = None
+        header = [0] + [0] * n
+        flat_shapes = []
+    header = _bcast(np.asarray(header, np.int64))
+    if int(header[0]) == 0:
+        return None
+    ranks = [int(r) for r in header[1:]]
+    # ship shapes padded to a fixed rank-sum so receivers match structure
+    total = sum(ranks)
+    pad = np.zeros(total, np.int64)
+    if flat_shapes:
+        pad[:len(flat_shapes)] = flat_shapes
+    shapes_flat = _bcast(pad)
+    shapes, off = [], 0
+    for r in ranks:
+        shapes.append(tuple(int(d) for d in shapes_flat[off:off + r]))
+        off += r
+    if values is None:
+        values = tuple(np.zeros(s, dt) for s, dt in zip(shapes, dtypes))
+    return tuple(np.asarray(v) for v in _bcast(values))
+
+
+class Process0Store:
+    """`ArtifactStore` adapter for multi-process runs (see module docstring:
+    writes on process 0 only, cache reads broadcast so resume decisions are
+    identical on every process)."""
+
+    def __init__(self, store):
+        self.store = store
+        self.result_dir = store.result_dir
+
+    # -- per-batch final patches --
+
+    def load_patch(self, batch_id: int):
+        got = self.store.load_patch(batch_id) if is_main() else None
+        return _bcast_optional_arrays(got, (np.float32, np.float32))
+
+    def save_patch(self, batch_id: int, mask, pattern):
+        if is_main():
+            self.store.save_patch(batch_id, mask, pattern)
+
+    # -- stage-0 artifacts (read inside attack.generate) --
+
+    def load_stage0(self, batch_id: int):
+        got = self.store.load_stage0(batch_id) if is_main() else None
+        return _bcast_optional_arrays(got, (np.float32, np.float32))
+
+    def save_stage0(self, batch_id: int, mask, pattern):
+        if is_main():
+            self.store.save_stage0(batch_id, mask, pattern)
+
+    # -- recorded attack targets --
+
+    def load_targets(self, batch_id: int):
+        got = self.store.load_targets(batch_id) if is_main() else None
+        # canonical int32 on BOTH sides of the broadcast: the recorded
+        # targets are int32 (jax default int; result.y), and a collective
+        # whose sender and receivers disagree on dtype/byte-width hangs
+        got = _bcast_optional_arrays(
+            None if got is None else (np.asarray(got, np.int32),),
+            (np.int32,))
+        return None if got is None else got[0]
+
+    def save_targets(self, batch_id: int, targets) -> None:
+        if is_main():
+            self.store.save_targets(batch_id, targets)
+
+    def resolve_targets(self, batch_id: int, rederive):
+        """Same contract as `ArtifactStore.resolve_targets`, with broadcast
+        reads. When neither the recorded targets nor stage-0 artifacts
+        exist, the rederivation closure (a jitted forward on replicated
+        arrays) runs identically on every process."""
+        t = self.load_targets(batch_id)
+        if t is not None:
+            return np.asarray(t)
+        s0 = self.load_stage0(batch_id)
+        if s0 is None:
+            raise FileNotFoundError(
+                f"targeted resume for batch {batch_id} needs the recorded "
+                "targets or the shared stage-0 artifacts on process 0")
+        return np.asarray(rederive(s0))
+
+    # -- PatchCleanser record cache: recompute under multi-process --
+
+    def load_pc_records(self, batch_id: int):
+        return None  # python objects; recomputing keeps all processes SPMD
+
+    def save_pc_records(self, batch_id: int, records) -> None:
+        if is_main():
+            self.store.save_pc_records(batch_id, records)
+
+
+__all__ = [
+    "is_main",
+    "Process0Store",
+]

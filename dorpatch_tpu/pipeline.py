@@ -47,34 +47,43 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
 
         return run_experiment_torch(cfg, verbose)
 
-    if jax.process_count() > 1:
-        # This driver is single-controller: the correctness filter, target
-        # draws, and record aggregation all assume the whole batch is host-
-        # addressable. Multi-host jobs drive the attack API directly —
-        # per-process shards go through `parallel.place_batch_multihost`
-        # into `parallel.make_sharded_attack(...).generate` (BASELINE
-        # config 5); a multi-process experiment driver is deliberately out
-        # of scope rather than silently wrong.
-        raise NotImplementedError(
-            "run_experiment is single-process; on multi-host jobs feed "
-            "per-process shards via parallel.place_batch_multihost and call "
-            "the attack/defense APIs directly")
+    multi = jax.process_count() > 1
+    if multi:
+        # SPMD driver (BASELINE config 5): every process runs this identical
+        # host program on identical host values; per-image state is
+        # replicated, the masked-image batch shards over the whole mesh, and
+        # artifact IO is process-0-only with broadcast reads — see
+        # parallel/multiproc.py for the design.
+        if cfg.mesh_data * cfg.mesh_mask <= 1:
+            raise ValueError(
+                "multi-process run_experiment needs an explicit mesh: set "
+                "mesh_data*mesh_mask to the global device count")
+        if cfg.carry_checkpoints:
+            raise ValueError(
+                "carry_checkpoints snapshots are process-local and would "
+                "diverge on resume; unsupported in multi-process runs")
     utils.set_global_seed(cfg.seed)       # host RNGs (`utils.py:16-21`)
     utils.select_device(cfg.device)       # `--device` flag (`utils.py:12-13`)
     utils.enable_compilation_cache()      # re-runs skip tunnel recompiles
-    if verbose:
+    is_main = (not multi) or parallel.multiproc.is_main()
+    if verbose and is_main:
         # lets log consumers (chip_validation) tell a real accelerator run
         # from jax silently falling back to the CPU backend
         print(f"backend: {jax.default_backend()} "
-              f"({len(jax.devices())} devices)", flush=True)
+              f"({len(jax.devices())} devices, "
+              f"{jax.process_count()} processes)", flush=True)
     rng = np.random.default_rng(cfg.seed)
     victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size,
                        gn_impl=cfg.gn_impl)
     store = ArtifactStore(results_path(cfg))
-    write_config_record(cfg, store.result_dir)
+    if multi:
+        store = parallel.multiproc.Process0Store(store)
+    if is_main:
+        write_config_record(cfg, store.result_dir)
     logger = observe.AttackMetricsLogger(
-        path=os.path.join(store.result_dir, "metrics.jsonl") if cfg.metrics_log else None,
-        echo_every=cfg.attack.report_interval if verbose else 0,
+        path=os.path.join(store.result_dir, "metrics.jsonl")
+        if (cfg.metrics_log and is_main) else None,
+        echo_every=cfg.attack.report_interval if (verbose and is_main) else 0,
     )
     mesh = None
     if cfg.mesh_data * cfg.mesh_mask > 1:
@@ -124,13 +133,21 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             y_np = y_np[correct]
             preds = preds[correct]
             if mesh is not None:
-                # the correctness filter makes the surviving batch size dynamic;
-                # shard it over the data axis when it divides, else replicate
-                # (per-image state is tiny next to the EOT activation batch)
-                try:
-                    x = parallel.place_batch(mesh, x)
-                except ValueError:
-                    x = jax.device_put(x, parallel.replicated(mesh))
+                if multi:
+                    # per-image state replicates on multi-process meshes
+                    # (the masked batch still shards over the whole mesh;
+                    # see parallel/multiproc.py) — place_replicated handles
+                    # the multi-controller construction
+                    x = parallel.place_replicated(mesh, np.asarray(x))
+                else:
+                    # the correctness filter makes the surviving batch size
+                    # dynamic; shard it over the data axis when it divides,
+                    # else replicate (per-image state is tiny next to the
+                    # EOT activation batch)
+                    try:
+                        x = parallel.place_batch(mesh, x)
+                    except ValueError:
+                        x = jax.device_put(x, parallel.replicated(mesh))
 
             cached = store.load_patch(i)
             if cached is not None:
@@ -216,7 +233,7 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             preds_adv_list.append(
                 np.asarray(jnp.argmax(victim.apply(victim.params, adv_x), -1)))
             records.extend(recs)
-            if verbose:
+            if verbose and is_main:
                 print(f"batch {i}: {len(y_np)} imgs in {time.time() - t0:.1f}s",
                       flush=True)
 
@@ -225,7 +242,7 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                  "acc_pc": [], "certified_acc_pc": [], "certified_asr_pc": [],
                  "evaluated_images": 0,
                  "report": "no correctly-classified images evaluated"}
-        if verbose:
+        if verbose and is_main:
             print(empty["report"])
         return empty
     preds_clean = np.concatenate(preds_list)
@@ -246,11 +263,12 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
         m["attack_images_per_sec"] = round(
             generated_images / sum(timer.block_seconds), 4)
     m["report"] = metrics.report_line(m)
-    if verbose:
+    if verbose and is_main:
         print(m["report"])
-    try:
-        with open(os.path.join(store.result_dir, "summary.json"), "w") as fh:
-            json.dump(m, fh, indent=1, default=float)
-    except OSError:
-        pass  # read-only results dir: the return value still carries everything
+    if is_main:
+        try:
+            with open(os.path.join(store.result_dir, "summary.json"), "w") as fh:
+                json.dump(m, fh, indent=1, default=float)
+        except OSError:
+            pass  # read-only results dir: the return value carries everything
     return m

@@ -48,12 +48,16 @@ def set_global_seed(seed: int = 1234) -> jax.Array:
 
 def select_device(device: str = "0") -> Optional[jax.Device]:
     """The reference's `--device` flag (`utils.py:12-13`): pick the default
-    accelerator by index. Returns None (and changes nothing) when the index
-    does not parse or is out of range — sharded runs address devices through
-    the mesh instead."""
+    accelerator by index. The reference's CUDA_VISIBLE_DEVICES index is a
+    per-host notion, so this indexes `jax.local_devices()` — under
+    `jax.distributed`, `jax.devices()[0]` may belong to ANOTHER process,
+    and pinning the default device there strands every eager output on a
+    non-addressable buffer. Returns None (and changes nothing) when the
+    index does not parse or is out of range — sharded runs address devices
+    through the mesh instead."""
     try:
         idx = int(str(device).split(",")[0])
-        dev = jax.devices()[idx]
+        dev = jax.local_devices()[idx]
     except (ValueError, IndexError):
         return None
     jax.config.update("jax_default_device", dev)

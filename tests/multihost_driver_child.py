@@ -1,0 +1,65 @@
+"""Child for the 2-process multi-process EXPERIMENT-DRIVER test.
+
+Where `multihost_child.py` drives the attack API directly, this child runs
+the full `pipeline.run_experiment` under `jax.distributed` — the SPMD
+driver path (`parallel/multiproc.py`): replicated per-image state, masked
+batch sharded over the joint (2,4) mesh, artifact IO on process 0 with
+broadcast cache reads. Run twice (fresh, then resumed) to also exercise the
+broadcast resume path: on the second run process 0 finds the cached patches
+and process 1 (which has NO files) must take the same branch with the same
+data.
+
+Usage: multihost_driver_child.py <process_id> <coordinator_port> <results_root>
+"""
+
+import json
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+results_root = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dorpatch_tpu.config import AttackConfig, DefenseConfig, ExperimentConfig  # noqa: E402
+from dorpatch_tpu.pipeline import run_experiment  # noqa: E402
+
+assert jax.process_count() == 2
+
+cfg = ExperimentConfig(
+    dataset="cifar10",
+    base_arch="resnet18",
+    img_size=32,
+    batch_size=2,
+    num_batches=1,
+    synthetic_data=True,
+    results_root=results_root,
+    mesh_data=2,
+    mesh_mask=4,
+    metrics_log=False,
+    # targeted=True so the resume run exercises the recorded-target
+    # broadcast (Process0Store.load_targets), not just the patch cache
+    attack=AttackConfig(targeted=True, sampling_size=4, max_iterations=2,
+                        sweep_interval=2, switch_iteration=2, dropout=1,
+                        dropout_sizes=(0.06,), basic_unit=4),
+    defense=DefenseConfig(ratios=(0.06,), num_mask_per_axis=2, chunk_size=8),
+)
+
+m1 = run_experiment(cfg, verbose=False)
+# second run: process 0 resumes from its artifacts; process 1 has the same
+# view only through the broadcast reads
+m2 = run_experiment(cfg, verbose=False)
+
+print("RESULT", pid, json.dumps({
+    "report1": m1["report"], "report2": m2["report"],
+    "evaluated": m1["evaluated_images"],
+    "resumed_attack_seconds": "attack_seconds" in m2,
+}), flush=True)

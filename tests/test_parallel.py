@@ -284,6 +284,69 @@ def test_two_process_multihost_feeding():
         assert f"proc {i}: OK" in out
 
 
+@pytest.mark.slow
+def test_two_process_experiment_driver(tmp_path):
+    """Full `run_experiment` under jax.distributed (BASELINE config 5's
+    last gap, r04 verdict weak #7): 2 processes x 4 virtual devices run the
+    SPMD driver — replicated per-image state, masked batch sharded over the
+    joint mesh, process-0-only artifacts with broadcast cache reads — twice
+    (fresh + resumed). Asserts identical reports across processes and runs,
+    and that only process 0 wrote artifacts."""
+    import glob as glob_mod
+    import json
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+
+    child = os.path.join(os.path.dirname(__file__), "multihost_driver_child.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    procs = [
+        subprocess.Popen([sys.executable, child, str(i), port,
+                          str(tmp_path / "results")], env=env,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    results = {}
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        for line in out.splitlines():
+            if line.startswith(f"RESULT {i} "):
+                results[i] = json.loads(line.split(" ", 2)[2])
+    assert set(results) == {0, 1}, outs
+    # identical host values on every process: the reports must agree
+    # exactly, fresh and resumed
+    assert results[0]["report1"] == results[1]["report1"]
+    assert results[0]["report2"] == results[1]["report2"]
+    assert results[0]["report1"] == results[0]["report2"]  # resume scored same
+    assert results[0]["evaluated"] >= 1
+    # the resumed run loaded cached patches: no attack was re-run
+    assert results[0]["resumed_attack_seconds"] is False
+    # artifacts written by process 0 only (both processes share this
+    # filesystem, so double-writes would be races): exactly two copies —
+    # the final per-budget patch and the stage-0 artifact its parent dir
+    # shares across budgets (ArtifactStore.save_stage0)
+    pts = glob_mod.glob(str(tmp_path / "results" / "**" / "adv_mask_*.pt"),
+                        recursive=True)
+    assert len(pts) == 2, pts
+    assert len({os.path.dirname(p) for p in pts}) == 2, pts
+
+
 def test_sharded_block_hlo_has_allreduce_no_big_allgather():
     """GSPMD-regression guard (r03 verdict #7): the compiled sharded attack
     block must contain the mask-axis all-reduce (the loss/grad contraction
