@@ -246,6 +246,45 @@ def test_place_batch_multihost_rejects_misaligned_per_image():
 
 
 @pytest.mark.slow
+def test_process0store_single_process_round_trip(tmp_path):
+    """`Process0Store`'s broadcast protocol (presence header -> padded
+    shape vector -> values) degenerates to identity on one process, so the
+    whole adapter is unit-testable here: reads must round-trip what the
+    wrapped store saved, misses must return None, and the PC-record cache
+    must always miss (multi-process recomputes certification)."""
+    from dorpatch_tpu.artifacts import ArtifactStore
+    from dorpatch_tpu.parallel.multiproc import Process0Store
+
+    store = Process0Store(ArtifactStore(str(tmp_path / "r" / "sub")))
+    assert store.load_patch(0) is None
+    assert store.load_stage0(0) is None
+    assert store.load_targets(0) is None
+
+    mask = np.random.default_rng(0).random((3, 8, 8, 1)).astype(np.float32)
+    pattern = np.random.default_rng(1).random((3, 8, 8, 3)).astype(np.float32)
+    store.save_patch(0, mask, pattern)
+    got_m, got_p = store.load_patch(0)
+    np.testing.assert_allclose(got_m, mask, rtol=1e-6)
+    np.testing.assert_allclose(got_p, pattern, rtol=1e-6)
+
+    store.save_targets(0, np.array([5, 1, 3], np.int32))
+    t = store.load_targets(0)
+    assert t.tolist() == [5, 1, 3]
+    assert store.resolve_targets(0, None).tolist() == [5, 1, 3]
+
+    store.save_stage0(1, mask, pattern)
+    s0 = store.load_stage0(1)
+    np.testing.assert_allclose(s0[0], mask, rtol=1e-6)
+    # recorded targets absent AND stage0 present: rederivation closure runs
+    got = store.resolve_targets(1, lambda s: np.array([9] * s[0].shape[0]))
+    assert got.tolist() == [9, 9, 9]
+
+    store.save_pc_records(0, [["rec"]])
+    assert store.load_pc_records(0) is None  # by design: recompute
+    # ...but the underlying store kept them for single-process reuse
+    assert store.store.load_pc_records(0) == [["rec"]]
+
+
 def test_two_process_multihost_feeding():
     """True 2-process multi-host run on CPU (VERDICT r2 ask #9): two
     jax.distributed processes, 4 virtual devices each, assemble a global
