@@ -54,6 +54,50 @@ def test_patch_selection_batched():
 
 # ---------- target selection ----------
 
+@pytest.mark.slow
+@pytest.mark.parametrize("family", ["vit", "resmlp"])
+def test_attack_and_certify_on_transformer_families(family):
+    """End-to-end smoke across model families (SURVEY §2 rows 4/21): the
+    whole stack — two-stage DorPatch, failure sweep, PatchCleanser
+    certification — is model-agnostic; the transformer/MLP victims trace
+    through the same jitted programs the conv victims do (their conversion
+    parity is covered in test_models; this pins the attack+defense path)."""
+    from dorpatch_tpu.attack import DorPatch
+    from dorpatch_tpu.config import AttackConfig, DefenseConfig
+    from dorpatch_tpu.defense import build_defenses
+
+    img = 32
+    if family == "vit":
+        from dorpatch_tpu.models.vit import ViT
+
+        model = ViT(num_classes=5, patch_size=8, dim=32, depth=2,
+                    num_heads=4, img_size=(img, img))
+    else:
+        from dorpatch_tpu.models.resmlp import ResMLP
+
+        model = ResMLP(num_classes=5, patch_size=8, dim=48, depth=3,
+                       img_size=img)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, img, img, 3)))
+
+    cfg = AttackConfig(sampling_size=4, max_iterations=2, sweep_interval=2,
+                       switch_iteration=2, dropout=1, dropout_sizes=(0.06,),
+                       basic_unit=4)
+    attack = DorPatch(model.apply, params, 5, cfg)
+    x = jax.random.uniform(jax.random.PRNGKey(1), (2, img, img, 3))
+    result = attack.generate(x, key=jax.random.PRNGKey(2))
+    assert result.adv_mask.shape == (2, img, img, 1)
+    assert np.isfinite(np.asarray(result.adv_pattern)).all()
+
+    d = build_defenses(model.apply, img,
+                       DefenseConfig(ratios=(0.06,), num_mask_per_axis=2,
+                                     chunk_size=8))[0]
+    adv_x = x * (1.0 - result.adv_mask) + result.adv_pattern * result.adv_mask
+    records = d.robust_predict(params, adv_x, 5)
+    assert len(records) == 2
+    assert all(0 <= r.prediction < 5 for r in records)
+
+
 def test_majority_incorrect_label():
     y = jnp.asarray([3, 1])
     preds = jnp.asarray([
