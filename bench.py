@@ -327,6 +327,26 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
         d.robust_predict(victim.params, x, victim.num_classes)
     # robust_predict materializes records via np.asarray: a real transfer
     dt = (time.perf_counter() - t0) / reps
+
+    # certify-mode MFU: forward-only FLOPs (XLA's own count at the chunked
+    # sweep's batch shape) x masked-forward rate over the chip peak; same
+    # guard as the attack child — unavailable cost model just omits it
+    mfu = None
+    try:
+        chunk = min(d.config.chunk_size, n_masks)
+        shaped = jax.ShapeDtypeStruct(
+            (chunk, img, img, 3),
+            jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+        compiled = jax.jit(victim.apply).lower(victim.params, shaped).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0]
+        f_fwd = float(analysis["flops"]) / chunk
+        peak = _peak_tflops(jax.devices()) * 1e12
+        if f_fwd and peak:
+            mfu = round(f_fwd * batch * n_masks / dt / peak, 4)
+    except Exception as e:
+        log(f"certify cost_analysis unavailable ({e}); mfu omitted")
     print(json.dumps({
         "ips": batch / dt,
         "batch": batch,
@@ -334,6 +354,7 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
         "masks_per_image": int(n_masks),
         "masked_fwd_per_sec": round(batch * n_masks / dt, 1),
         "seconds_per_batch": round(dt, 4),
+        "mfu": mfu,
     }))
 
 
