@@ -298,4 +298,11 @@ def create_torch_model(arch: str, num_classes: int) -> nn.Module:
         return ResMLPTorch(num_classes=num_classes)
     if arch in ("resnet18", "cifar_resnet18"):
         return CifarResNet18Torch(num_classes=num_classes)
+    if arch == "cifar_vit":
+        from dorpatch_tpu.models.vit import CIFAR_VIT
+
+        return ViTTorch(num_classes=num_classes, dim=CIFAR_VIT["dim"],
+                        depth=CIFAR_VIT["depth"], heads=CIFAR_VIT["num_heads"],
+                        patch=CIFAR_VIT["patch_size"],
+                        img=CIFAR_VIT["img_size"][0])
     raise NotImplementedError(f"torch backend arch: {arch}")

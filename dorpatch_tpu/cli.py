@@ -23,7 +23,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data_dir", default="/home/data/data")
     p.add_argument("--model_dir", default="pretrained_models/")
     p.add_argument("--base_arch", "-ba", default="resnetv2",
-                   choices=["resnetv2", "vit", "resmlp", "resnet18"])
+                   choices=["resnetv2", "vit", "resmlp", "resnet18",
+                            "cifar_vit"])
     p.add_argument("--targeted", "-t", action="store_true")
     p.add_argument("--patch_budget", type=float, default=0.12)
     p.add_argument("--attack", "-a", default="DorPatch", choices=["DorPatch"])

@@ -139,6 +139,54 @@ def convert_vit(sd: Mapping[str, np.ndarray], depth: int = 12, num_heads: int = 
     return {"params": params}
 
 
+def export_vit(params: Mapping) -> Dict[str, np.ndarray]:
+    """Inverse of `convert_vit`: flax ViT params -> timm-shaped state_dict
+    (per-projection DenseGeneral kernels re-fused into the `[3D, D]` qkv
+    Linear, HWIO->OIHW patch embed, Dense kernels transposed). Depth/dim
+    are inferred from the pytree, so the same exporter serves the small
+    `vit_cifar` trained victims and any full-size ViT. Round-trip
+    (export -> `ViTTorch.load_state_dict(strict=True)` -> `convert_vit`)
+    is pinned by `tests/test_models.py`."""
+    p = params["params"] if "params" in params else params
+
+    def arr(a):
+        return np.asarray(a, dtype=np.float32)
+
+    dim = arr(p["cls_token"]).shape[-1]
+    sd: Dict[str, np.ndarray] = {
+        "cls_token": arr(p["cls_token"]),
+        "pos_embed": arr(p["pos_embed"]),
+        "patch_embed.proj.weight": arr(p["patch_embed"]["kernel"]).transpose(3, 2, 0, 1),
+        "patch_embed.proj.bias": arr(p["patch_embed"]["bias"]),
+        "norm.weight": arr(p["norm"]["scale"]),
+        "norm.bias": arr(p["norm"]["bias"]),
+        "head.weight": arr(p["head"]["kernel"]).T,
+        "head.bias": arr(p["head"]["bias"]),
+    }
+    depth = sum(1 for k in p if k.startswith("block"))
+    for i in range(depth):
+        blk = p[f"block{i}"]
+        dst = f"blocks.{i}."
+        sd[dst + "norm1.weight"] = arr(blk["norm1"]["scale"])
+        sd[dst + "norm1.bias"] = arr(blk["norm1"]["bias"])
+        sd[dst + "norm2.weight"] = arr(blk["norm2"]["scale"])
+        sd[dst + "norm2.bias"] = arr(blk["norm2"]["bias"])
+        attn = blk["attn"]
+        # [D, heads, hd] kernel -> [D_out, D_in] torch rows, stacked q/k/v
+        sd[dst + "attn.qkv.weight"] = np.concatenate(
+            [arr(attn[n]["kernel"]).reshape(dim, dim).T
+             for n in ("query", "key", "value")], axis=0)
+        sd[dst + "attn.qkv.bias"] = np.concatenate(
+            [arr(attn[n]["bias"]).reshape(dim) for n in ("query", "key", "value")])
+        sd[dst + "attn.proj.weight"] = arr(attn["out"]["kernel"]).reshape(dim, dim).T
+        sd[dst + "attn.proj.bias"] = arr(attn["out"]["bias"])
+        sd[dst + "mlp.fc1.weight"] = arr(blk["mlp_fc1"]["kernel"]).T
+        sd[dst + "mlp.fc1.bias"] = arr(blk["mlp_fc1"]["bias"])
+        sd[dst + "mlp.fc2.weight"] = arr(blk["mlp_fc2"]["kernel"]).T
+        sd[dst + "mlp.fc2.bias"] = arr(blk["mlp_fc2"]["bias"])
+    return sd
+
+
 def convert_cifar_resnet18(
     sd: Mapping[str, np.ndarray], stage_sizes: Sequence[int] = (2, 2, 2, 2)
 ) -> Dict:

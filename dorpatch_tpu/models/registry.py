@@ -44,15 +44,26 @@ def resolve_arch(arch: str) -> str:
     plus the framework's own small CIFAR victim for sweep configs."""
     if arch in ("resnet18", "cifar_resnet18"):
         return "cifar_resnet18"
+    if arch == "cifar_vit":
+        return "cifar_vit"
     for tm in TIMM_MODELS:
         if arch in tm:
             return tm
-    raise ValueError(f"unknown architecture {arch!r}; supported: {TIMM_MODELS + ('cifar_resnet18',)}")
+    raise ValueError(
+        f"unknown architecture {arch!r}; supported: "
+        f"{TIMM_MODELS + ('cifar_resnet18', 'cifar_vit')}")
 
 
 def checkpoint_path(model_dir: str, dataset: str, timm_name: str) -> str:
     """The PatchCleanser-release checkpoint naming contract (`utils.py:59-61`)."""
     return os.path.join(model_dir, dataset, f"{timm_name}_cutout2_128_{dataset}.pth")
+
+
+def build_bare_model(timm_name: str, num_classes: int, gn_impl: str = "auto"):
+    """Public bare-module builder (no normalization fold, no checkpoint):
+    the flax module for a canonical arch name — used by `train.py`, which
+    needs the raw module to init/train before exporting."""
+    return _build_flax(timm_name, num_classes, gn_impl=gn_impl)
 
 
 def _build_flax(timm_name: str, num_classes: int, gn_impl: str = "auto"):
@@ -72,6 +83,10 @@ def _build_flax(timm_name: str, num_classes: int, gn_impl: str = "auto"):
         from dorpatch_tpu.models.small import CifarResNet18
 
         return CifarResNet18(num_classes=num_classes)
+    if timm_name == "cifar_vit":
+        from dorpatch_tpu.models.vit import vit_cifar
+
+        return vit_cifar(num_classes)
     raise NotImplementedError(timm_name)
 
 
@@ -92,6 +107,12 @@ def _convert(timm_name: str, state_dict):
         from dorpatch_tpu.models.convert import convert_cifar_resnet18
 
         return convert_cifar_resnet18(state_dict)
+    if timm_name == "cifar_vit":
+        from dorpatch_tpu.models.convert import convert_vit
+        from dorpatch_tpu.models.vit import CIFAR_VIT
+
+        return convert_vit(state_dict, depth=CIFAR_VIT["depth"],
+                           num_heads=CIFAR_VIT["num_heads"])
     raise NotImplementedError(timm_name)
 
 

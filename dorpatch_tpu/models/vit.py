@@ -77,3 +77,18 @@ class ViT(nn.Module):
 
 def vit_base_patch16(num_classes: int) -> ViT:
     return ViT(num_classes=num_classes)
+
+
+# The framework's own small transformer victim for 32x32 trained-victim
+# protocol runs (the analog of `models.small.CifarResNet18` for the ViT
+# family): 8x8 grid of 4x4 patches + cls = 65 tokens. The reference only
+# consumes pretrained 224px victims (`/root/reference/utils.py:47-63`); this
+# config exists so the trained-victim parity evidence (train.py ->
+# torch-.pth export -> converter round-trip -> torch-oracle certified-ASR)
+# covers a second, non-convolutional family offline.
+CIFAR_VIT = dict(patch_size=4, dim=128, depth=6, num_heads=4,
+                 img_size=(32, 32))
+
+
+def vit_cifar(num_classes: int) -> ViT:
+    return ViT(num_classes=num_classes, **CIFAR_VIT)

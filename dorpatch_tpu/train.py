@@ -37,6 +37,10 @@ import numpy as np
 @dataclasses.dataclass(frozen=True)
 class TrainConfig:
     dataset: str = "cifar10"
+    # trainable victim families: "cifar_resnet18" (conv) and "cifar_vit"
+    # (transformer) — both 32px, both exported under the reference's
+    # checkpoint naming so `models/registry.get_model` loads them
+    arch: str = "cifar_resnet18"
     img_size: int = 32
     n_per_class_train: int = 1500
     n_per_class_test: int = 200
@@ -71,8 +75,23 @@ def _augment(key, imgs):
     return jnp.where(flip, imgs[:, :, ::-1, :], imgs)
 
 
+def _trainable_arch(arch: str) -> str:
+    """Canonical registry name of a trainable victim family. Only the small
+    32px victims are trainable offline (the 224px families would need real
+    data at a scale the procedural task cannot provide)."""
+    from dorpatch_tpu.models import registry
+
+    name = registry.resolve_arch(arch)
+    if name not in ("cifar_resnet18", "cifar_vit"):
+        raise ValueError(
+            f"arch {arch!r} is not trainable offline; use cifar_resnet18 "
+            "or cifar_vit (the 224px timm families need real datasets)")
+    return name
+
+
 def train_victim(cfg: TrainConfig = TrainConfig(), log=print) -> Tuple[dict, dict]:
-    """Train CifarResNet18 on the procedural task; returns (params, report).
+    """Train the cfg.arch victim (cifar_resnet18 or cifar_vit) on the
+    procedural task; returns (params, report).
 
     report: {"test_acc", "train_acc", "steps", "seconds", "backend"}.
     """
@@ -82,8 +101,10 @@ def train_victim(cfg: TrainConfig = TrainConfig(), log=print) -> Tuple[dict, dic
 
     from dorpatch_tpu import data as data_lib
     from dorpatch_tpu import utils
-    from dorpatch_tpu.models.small import CifarResNet18
 
+    from dorpatch_tpu.models import registry
+
+    arch_name = _trainable_arch(cfg.arch)  # fail fast, before the data load
     utils.enable_compilation_cache()
 
     tr_x, tr_y = data_lib.training_arrays(
@@ -96,7 +117,7 @@ def train_victim(cfg: TrainConfig = TrainConfig(), log=print) -> Tuple[dict, dic
         seed=1234, split="test")
     n_classes = int(tr_y.max()) + 1
 
-    model = CifarResNet18(num_classes=n_classes)
+    model = registry.build_bare_model(arch_name, n_classes)
     key = jax.random.PRNGKey(cfg.seed)
     params = jax.jit(model.init)(
         key, jnp.zeros((1, cfg.img_size, cfg.img_size, 3)))
@@ -184,17 +205,21 @@ def train_victim(cfg: TrainConfig = TrainConfig(), log=print) -> Tuple[dict, dic
     return params, report
 
 
-def save_victim_checkpoint(params, out_dir: str, dataset: str = "cifar10") -> str:
+def save_victim_checkpoint(params, out_dir: str, dataset: str = "cifar10",
+                           arch: str = "cifar_resnet18") -> str:
     """Export trained flax params as a torch `.pth` under the reference's
     checkpoint naming contract, loadable by `models/registry.get_model`."""
     from dorpatch_tpu.models import registry
-    from dorpatch_tpu.models.convert import export_cifar_resnet18
+    from dorpatch_tpu.models.convert import export_cifar_resnet18, export_vit
 
-    path = registry.checkpoint_path(out_dir, dataset, "cifar_resnet18")
+    name = _trainable_arch(arch)  # clear error for non-trainable families
+    exporter = {"cifar_resnet18": export_cifar_resnet18,
+                "cifar_vit": export_vit}[name]
+    path = registry.checkpoint_path(out_dir, dataset, name)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     import torch
 
-    sd = {k: torch.as_tensor(v) for k, v in export_cifar_resnet18(params).items()}
+    sd = {k: torch.as_tensor(v) for k, v in exporter(params).items()}
     torch.save({"state_dict": sd}, path)
     return path
 
@@ -204,6 +229,9 @@ def main(argv=None) -> int:
     p.add_argument("--out", default="pretrained_models/",
                    help="model dir to export the checkpoint into")
     p.add_argument("--dataset", default="cifar10")
+    p.add_argument("--arch", default="cifar_resnet18",
+                   choices=("cifar_resnet18", "resnet18", "cifar_vit"),
+                   help="victim family to train (32px offline victims)")
     p.add_argument("--epochs", type=int, default=12)
     p.add_argument("--batch-size", type=int, default=128)
     p.add_argument("--n-per-class", type=int, default=1500)
@@ -215,12 +243,12 @@ def main(argv=None) -> int:
     p.add_argument("--data-dir", default="data/")
     args = p.parse_args(argv)
 
-    cfg = TrainConfig(dataset=args.dataset, epochs=args.epochs,
+    cfg = TrainConfig(dataset=args.dataset, arch=args.arch, epochs=args.epochs,
                       batch_size=args.batch_size, lr=args.lr, seed=args.seed,
                       n_per_class_train=args.n_per_class,
                       data_source=args.data_source, data_dir=args.data_dir)
     params, report = train_victim(cfg)
-    path = save_victim_checkpoint(params, args.out, args.dataset)
+    path = save_victim_checkpoint(params, args.out, args.dataset, args.arch)
     print(f"saved {path}; report={report}")
     return 0
 

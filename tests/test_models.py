@@ -137,6 +137,31 @@ def test_vit_base_parity_full():
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=5e-4)
 
 
+def test_vit_export_round_trips_through_torch_twin():
+    """export_vit (flax -> timm-shaped .pth state_dict) must load into the
+    torch twin strict=True with logits parity, and convert_vit must invert
+    it exactly — the cifar_vit trained-victim export path."""
+    from dorpatch_tpu.backends.torch_models import create_torch_model
+    from dorpatch_tpu.models.convert import convert_vit, export_vit
+    from dorpatch_tpu.models.vit import CIFAR_VIT, vit_cifar
+
+    fm = vit_cifar(10)
+    params = fm.init(jax.random.PRNGKey(3), jnp.zeros((1, 32, 32, 3)))
+    sd = export_vit(params)
+    tm = create_torch_model("cifar_vit", 10).eval()
+    tm.load_state_dict({k: torch.as_tensor(v) for k, v in sd.items()},
+                       strict=True)
+    x = np.random.default_rng(8).normal(size=(2, 3, 32, 32)).astype(np.float32)
+    got, want = _logits_pair(tm, fm, params, x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    p2 = convert_vit(sd, depth=CIFAR_VIT["depth"],
+                     num_heads=CIFAR_VIT["num_heads"])
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_resmlp_parity_tiny():
     from dorpatch_tpu.backends.torch_models import ResMLPTorch
     from dorpatch_tpu.models.convert import convert_resmlp

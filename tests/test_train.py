@@ -74,6 +74,43 @@ def test_train_step_learns_and_checkpoint_round_trips(tmp_path):
     assert path.endswith("cifar_resnet18_cutout2_128_cifar10.pth")
 
 
+@pytest.mark.slow
+def test_train_vit_family_and_checkpoint_round_trips(tmp_path):
+    """The second trainable family: cifar_vit trains through the same jitted
+    step and exports a .pth that registry.get_model('cifar_vit') loads with
+    identical logits (trained-victim parity beyond the conv family)."""
+    from dorpatch_tpu.models import registry
+    from dorpatch_tpu.models.vit import vit_cifar
+    from dorpatch_tpu.train import TrainConfig, save_victim_checkpoint, train_victim
+
+    cfg = TrainConfig(arch="cifar_vit", n_per_class_train=24,
+                      n_per_class_test=8, epochs=1, batch_size=48,
+                      warmup_steps=2, seed=1)
+    params, report = train_victim(cfg, log=lambda *a: None)
+    assert report["steps"] == 240 // 48
+    assert 0.0 <= report["test_acc"] <= 1.0
+
+    path = save_victim_checkpoint(params, str(tmp_path), "cifar10",
+                                  arch="cifar_vit")
+    assert path.endswith("cifar_vit_cutout2_128_cifar10.pth")
+    victim = registry.get_model("cifar10", "cifar_vit",
+                                model_dir=str(tmp_path), img_size=32)
+    assert victim.from_checkpoint
+
+    x = jax.random.uniform(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    want = vit_cifar(10).apply(params, (x - 0.5) / 0.5)
+    got = victim.apply(victim.params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_train_victim_rejects_untrainable_arch():
+    from dorpatch_tpu.train import TrainConfig, train_victim
+
+    with pytest.raises(ValueError, match="not trainable offline"):
+        train_victim(TrainConfig(arch="resnetv2"), log=lambda *a: None)
+
+
 def _write_cifar10_batch(path, n, seed, label_key=b"labels"):
     import pickle
 

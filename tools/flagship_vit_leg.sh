@@ -1,0 +1,33 @@
+#!/bin/bash
+# Trained-victim protocol leg for the SECOND victim family (cifar_vit):
+# train the 32px ViT on the procedural labeled task, run the full two-stage
+# attack + 4-radius certification against it, then score torch-oracle
+# certified-ASR parity — the same evidence chain as the cifar_resnet18
+# hedge (tools/flagship_cpu_hedge.sh), proving the trained-victim parity
+# acceptance is not conv-family-specific. CPU-scaled config (sampling 16,
+# 200 iters), recorded in the run's config.json so the oracle scores the
+# same scale.
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/flagship_vit_leg.log
+echo "$(date -u +%FT%TZ) vit-leg: training" >> "$LOG"
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m dorpatch_tpu.train \
+  --arch cifar_vit --out artifacts/victim_vit_r05 --epochs 12 \
+  --n-per-class 1000 --lr 1e-3 >> "$LOG" 2>&1
+rc=$?
+echo "$(date -u +%FT%TZ) vit-leg: train rc=$rc" >> "$LOG"
+[ $rc -ne 0 ] && exit $rc
+echo "$(date -u +%FT%TZ) vit-leg: attacking" >> "$LOG"
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m dorpatch_tpu.cli \
+  --data-source procedural --dataset cifar10 --base_arch cifar_vit \
+  --img-size 32 -b 4 --num-batches 2 --sampling-size 16 \
+  --max-iterations 200 --model_dir artifacts/victim_vit_r05 \
+  --results-root artifacts/flagship_vit_r05 >> "$LOG" 2>&1
+rc=$?
+echo "$(date -u +%FT%TZ) vit-leg: attack rc=$rc" >> "$LOG"
+[ $rc -ne 0 ] && exit $rc
+echo "$(date -u +%FT%TZ) vit-leg: torch-oracle parity" >> "$LOG"
+python tools/parity_flagship.py --jax-root artifacts/flagship_vit_r05 \
+  --model-dir artifacts/victim_vit_r05 --attack \
+  --out artifacts/PARITY_vit_r05.json >> "$LOG" 2>&1
+echo "$(date -u +%FT%TZ) vit-leg: parity rc=$?" >> "$LOG"
