@@ -15,6 +15,20 @@ parity = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(parity)
 
 
+def test_derived_roots_are_per_jax_root():
+    """Oracle/torch staging roots must be derived from the jax tree, not
+    shared constants: the r05 vit-family parity run rmtree'd the conv
+    run's staged oracle evidence through the old shared default."""
+    assert parity.derived_roots("artifacts/flagship_vit_r05") == (
+        "artifacts/flagship_vit_r05_oracle", "artifacts/flagship_vit_r05_torch")
+    # trailing slash and dot segments must not nest roots inside the tree
+    assert parity.derived_roots("artifacts/a/") == parity.derived_roots(
+        "artifacts/a/.")
+    a = parity.derived_roots("artifacts/a/")
+    b = parity.derived_roots("artifacts/b")
+    assert a[0] != b[0] and a[1] != b[1]
+
+
 def test_stage_oracle_root_excludes_pc_cache(tmp_path):
     jax_root = tmp_path / "jax" / "cfg=1" / "sub=2"
     jax_root.mkdir(parents=True)

@@ -87,6 +87,14 @@ def flagship_config(results_root: str, backend: str,
     )
 
 
+def derived_roots(jax_root: str) -> tuple:
+    """(oracle_root, torch_root) for a given jax flagship tree. Derived —
+    not shared constants — so parity runs for different trees (conv vs vit
+    family legs) never rmtree each other's staged evidence."""
+    base = os.path.normpath(jax_root)
+    return base + "_oracle", base + "_torch"
+
+
 def stage_oracle_root(jax_root: str, oracle_root: str) -> int:
     """Copy patch + target artifacts (NOT the adv_PC_* certification cache)
     from the jax flagship tree into a fresh tree for the torch oracle.
@@ -154,14 +162,18 @@ def main(argv=None) -> int:
     p.add_argument("--attack", action="store_true",
                    help="also run the independent torch attack (slow: the "
                         "full two-stage optimization on CPU)")
-    p.add_argument("--out",
-                   default=os.path.join(ROOT, "artifacts", "PARITY_r05.json"))
+    p.add_argument("--out", default="",
+                   help="report path (default: <jax-root>_PARITY.json, "
+                        "derived so different trees never overwrite each "
+                        "other's parity evidence)")
     p.add_argument("--tol", type=float, default=1e-6,
                    help="max |delta| in certified-ASR percentage points for "
                         "the oracle-certify leg to count as parity (same "
                         "patches, same images: exact agreement expected "
                         "unless a borderline logit flips)")
     args = p.parse_args(argv)
+    if not args.out:
+        args.out = os.path.normpath(args.jax_root) + "_PARITY.json"
 
     jax_m, jax_path = load_jax_summary(args.jax_root)
     if jax_m is None:
@@ -173,8 +185,11 @@ def main(argv=None) -> int:
 
     # Leg 1: torch oracle certifies the jax patches. Staged into a fresh
     # tree so the torch pipeline's cached-patch branch fires but its
-    # PC-record cache misses (see stage_oracle_root).
-    oracle_root = os.path.join(ROOT, "artifacts", "flagship_r05_oracle")
+    # PC-record cache misses (see stage_oracle_root). Roots are derived
+    # from --jax-root so parity runs for different flagship trees (e.g.
+    # the conv and vit family legs) never rmtree each other's staged
+    # evidence.
+    oracle_root, torch_root = derived_roots(args.jax_root)
     staged = stage_oracle_root(args.jax_root, oracle_root)
     if staged == 0:
         print(f"no patch artifacts under {args.jax_root}", file=sys.stderr)
@@ -201,15 +216,15 @@ def main(argv=None) -> int:
     # Leg 2 (optional): independent torch attack, own artifact tree.
     if args.attack:
         atk_cfg = flagship_config(
-            os.path.join(ROOT, "artifacts", "flagship_r05_torch"), "torch",
-            args.model_dir, config_path=jax_config_path)
+            torch_root, "torch", args.model_dir,
+            config_path=jax_config_path)
         torch_atk = run_experiment(atk_cfg, verbose=True)
         out["oracle_attack"] = {
             "rows": parity_rows(jax_m, torch_atk),
             "torch_report": torch_atk.get("report"),
         }
 
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1, default=float)
     print(json.dumps({"parity": out["oracle_certify"]["parity"],
