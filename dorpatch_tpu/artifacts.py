@@ -37,6 +37,15 @@ def results_path(cfg: ExperimentConfig) -> str:
         ("density", cfg.attack.density),
         ("structured", cfg.attack.structured),
     ]
+    # TPU-extension knobs that change what the cached artifacts MEAN are
+    # path keys too — only when non-default, so default runs stay
+    # byte-compatible with the reference contract. Without these, a warm
+    # results tree would serve non-dual patches to a --dual run and
+    # n_patch=1 certification records to a --defense-n-patch 2 run.
+    if cfg.attack.dual:
+        keys.append(("dual", True))
+    if cfg.defense.n_patch != 1:
+        keys.append(("defense_n_patch", cfg.defense.n_patch))
     top = "_".join(f"{k}={v}" for k, v in keys)
     sub = f"num_patch={cfg.attack.num_patch}_patch_budget={cfg.attack.patch_budget}"
     return os.path.join(cfg.results_root, top, sub)

@@ -86,6 +86,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GroupNorm+ReLU impl for ResNetV2 victims (auto: "
                         "fused Pallas kernel on single-chip TPU, flax "
                         "elsewhere — see ops/fused_gn.py)")
+    p.add_argument("--dual", action="store_true",
+                   help="second independent occlusion layer per EOT sample "
+                        "(the reference's dormant dual branch, "
+                        "attack.py:208-218, live here in both backends)")
+    p.add_argument("--defense-n-patch", type=int, default=1, choices=[1, 2],
+                   help="PatchCleanser mask-set patch count for the defense "
+                        "bank (the reference always certifies n_patch=1; "
+                        "2 = pair/triple mask sets, PatchCleanser.py:24-37)")
     p.add_argument("--remat-policy", default="full",
                    choices=["full", "conv", "dots"],
                    help="what an active remat recomputes: full = the whole "
@@ -115,6 +123,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         structured=args.structured,
         eps=args.epsilon,
         num_patch=args.num_patch,
+        dual=args.dual,
         use_pallas=args.use_pallas,
         compute_dtype=args.compute_dtype,
         remat=args.remat,
@@ -142,7 +151,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         trace_dir=args.trace_dir,
         carry_checkpoints=args.carry_checkpoints,
         attack=attack,
-        defense=DefenseConfig(use_pallas=args.use_pallas),
+        defense=DefenseConfig(use_pallas=args.use_pallas,
+                              n_patch=args.defense_n_patch),
     )
 
 

@@ -23,6 +23,24 @@ def test_results_path_matches_reference_contract():
     )
 
 
+def test_results_path_encodes_dual_and_defense_n_patch():
+    """Cache-safety: knobs that change what cached artifacts MEAN (dual
+    patches, 2-patch certification records) must separate the results
+    tree — but only when non-default, keeping the reference byte-compat."""
+    import dataclasses
+
+    from dorpatch_tpu.config import AttackConfig, DefenseConfig
+
+    base = ExperimentConfig(results_root="results")
+    dual = dataclasses.replace(base, attack=AttackConfig(dual=True))
+    np2 = dataclasses.replace(base, defense=DefenseConfig(n_patch=2))
+    assert "dual=True" in results_path(dual)
+    assert "defense_n_patch=2" in results_path(np2)
+    assert "dual" not in results_path(base)
+    assert "defense_n_patch" not in results_path(base)
+    assert len({results_path(c) for c in (base, dual, np2)}) == 3
+
+
 def test_artifact_store_roundtrip(tmp_path):
     store = ArtifactStore(str(tmp_path / "results" / "cfg" / "sub"))
     mask = np.random.default_rng(0).uniform(size=(1, 16, 16, 1)).astype(np.float32)
@@ -89,6 +107,18 @@ def test_cli_reference_flags():
     assert cfg.attack.dropout == 1
     assert cfg.synthetic_data
     assert cfg.attack.max_iterations == 7
+    assert not cfg.attack.dual and cfg.defense.n_patch == 1  # defaults
+
+
+def test_cli_dual_and_defense_n_patch_flags():
+    """TPU extensions: --dual (the reference's dormant second-occlusion
+    branch, live in both backends) and --defense-n-patch (2-patch
+    PatchCleanser mask sets) must reach the config dataclasses."""
+    args = build_parser().parse_args(
+        ["--synthetic", "--dual", "--defense-n-patch", "2"])
+    cfg = config_from_args(args)
+    assert cfg.attack.dual
+    assert cfg.defense.n_patch == 2
 
 
 @pytest.mark.slow

@@ -26,7 +26,8 @@ a live TPU job without touching the device grant (the torch oracle and this
 comparison never need jax devices).
 
 Usage:
-  python tools/parity_flagship.py [--attack] [--out artifacts/PARITY_r05.json]
+  python tools/parity_flagship.py [--attack] [--jax-root TREE]
+  # report default: <jax-root>_PARITY.json (derived, per-tree)
 """
 
 from __future__ import annotations
