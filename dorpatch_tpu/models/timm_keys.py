@@ -70,11 +70,23 @@ def resnetv2_50x1_keys(num_classes: int) -> "OrderedDict[str, Shape]":
 
 
 def vit_base_patch16_224_keys(num_classes: int) -> "OrderedDict[str, Shape]":
-    dim, depth, mlp = 768, 12, 3072
+    return _vit_keys(num_classes, dim=768, depth=12, n_tokens=197, patch=16)
+
+
+def cifar_vit_keys(num_classes: int) -> "OrderedDict[str, Shape]":
+    """The framework's OWN small transformer-victim contract (not a timm
+    model): `models/vit.py:CIFAR_VIT` as exported by `export_vit` — 8x8
+    grid of 4px patches + cls = 65 tokens, dim 128, depth 6."""
+    return _vit_keys(num_classes, dim=128, depth=6, n_tokens=65, patch=4)
+
+
+def _vit_keys(num_classes: int, dim: int, depth: int, n_tokens: int,
+              patch: int) -> "OrderedDict[str, Shape]":
+    mlp = 4 * dim
     keys: "OrderedDict[str, Shape]" = OrderedDict()
     keys["cls_token"] = (1, 1, dim)
-    keys["pos_embed"] = (1, 197, dim)
-    keys["patch_embed.proj.weight"] = (dim, 3, 16, 16)
+    keys["pos_embed"] = (1, n_tokens, dim)
+    keys["patch_embed.proj.weight"] = (dim, 3, patch, patch)
     keys["patch_embed.proj.bias"] = (dim,)
     for i in range(depth):
         pre = f"blocks.{i}."
@@ -159,6 +171,7 @@ _CONTRACTS = {
     "vit_base_patch16_224": vit_base_patch16_224_keys,
     "resmlp_24_distilled_224": resmlp_24_keys,
     "cifar_resnet18": cifar_resnet18_keys,
+    "cifar_vit": cifar_vit_keys,
 }
 
 

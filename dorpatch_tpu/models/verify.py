@@ -38,7 +38,8 @@ import numpy as np
 
 def _infer_arch(path: str) -> str:
     base = os.path.basename(path)
-    for arch in ("resnetv2", "vit", "resmlp", "resnet18"):
+    # cifar_vit before vit: a "cifar_vit_*" filename contains both
+    for arch in ("cifar_vit", "resnetv2", "vit", "resmlp", "resnet18"):
         if arch in base:
             return arch
     return "resnetv2"
@@ -142,13 +143,17 @@ def main(argv=None) -> int:
         "with logit parity against the torch twin")
     p.add_argument("checkpoint", help="path to the .pth file")
     p.add_argument("--arch", default=None,
-                   choices=["resnetv2", "vit", "resmlp", "resnet18"],
+                   choices=["resnetv2", "vit", "resmlp", "resnet18",
+                            "cifar_vit"],
                    help="architecture (default: inferred from the filename)")
     p.add_argument("--dataset", default=None,
                    choices=["imagenet", "cifar10", "cifar100"],
                    help="dataset -> class count (default: inferred)")
     p.add_argument("--batch", type=int, default=4)
-    p.add_argument("--img-size", type=int, default=224)
+    p.add_argument("--img-size", type=int, default=0,
+                   help="0 = by arch: 32 for the small trained-victim "
+                        "families (fixed pos_embed for cifar_vit), 224 "
+                        "for the timm models")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--tol", type=float, default=1e-3)
     p.add_argument("--keys-only", action="store_true",
@@ -177,11 +182,14 @@ def main(argv=None) -> int:
             for item in report[field][:20]:
                 print(f"  {field}: {item}")
         return 1 if drift else 0
+    arch = args.arch or _infer_arch(args.checkpoint)
+    img_size = args.img_size or (
+        32 if arch in ("resnet18", "cifar_resnet18", "cifar_vit") else 224)
     report = verify_checkpoint(
         args.checkpoint,
-        args.arch or _infer_arch(args.checkpoint),
+        arch,
         args.dataset or _infer_dataset(args.checkpoint),
-        args.batch, args.img_size, args.seed,
+        args.batch, img_size, args.seed,
     )
     ok = report["max_abs_delta"] <= args.tol and report["argmax_agree"]
     verdict = "OK" if ok else "FAIL"

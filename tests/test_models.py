@@ -209,6 +209,7 @@ _CONTRACT_CASES = [
     ("vit_base_patch16_224", 10, 224),
     ("resmlp_24_distilled_224", 10, 224),
     ("cifar_resnet18", 10, 32),
+    ("cifar_vit", 10, 32),
 ]
 
 
@@ -273,6 +274,7 @@ def test_verify_keys_reports_drift(tmp_path):
     ("vit", "vit_base_patch16_224"),
     ("resmlp", "resmlp_24_distilled_224"),
     ("resnet18", "cifar_resnet18"),
+    ("cifar_vit", "cifar_vit"),
 ])
 def test_torch_twin_state_dict_equals_contract(arch, timm_name):
     """The torch twins must carry EXACTLY the vendored contract's keys and

@@ -62,6 +62,8 @@ def test_verify_rejects_wrong_arch_keys(tmp_path):
 
 def test_infer_helpers():
     assert verify._infer_arch("x/vit_base_patch16_224_cutout2_128_cifar10.pth") == "vit"
+    # cifar_vit must win over the "vit" substring
+    assert verify._infer_arch("x/cifar_vit_cutout2_128_cifar10.pth") == "cifar_vit"
     assert verify._infer_dataset("vit_base_patch16_224_cutout2_128_cifar100.pth") == "cifar100"
     assert verify._infer_dataset("resmlp_24_distilled_224_imagenet.pth") == "imagenet"
     assert np.isfinite(1.0)  # keep numpy import honest
