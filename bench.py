@@ -27,7 +27,10 @@ MFU accounting: the victim's forward FLOPs come from XLA's own cost model
 (`jit(fwd).lower().compile().cost_analysis()["flops"]`), useful work per
 step = 3x forward (fwd+bwd) x EOT x batch, divided by measured step time and
 the chip's peak bf16 FLOP/s (BENCH_PEAK_TFLOPS overrides; default 197 for
-TPU v5e/"v5 lite", 275 for v4). Rematerialization (off by default here;
+TPU v5e/"v5 lite", 275 for v4). The division itself is the shared
+`observe.StepTimer.summary` formula — the same accounting the offline
+telemetry report uses — so the bench's MFU row cannot drift from the
+framework's. Rematerialization (off by default here;
 re-enabled automatically on OOM) re-executes the forward, so its extra FLOPs
 are real but not "useful" — MFU is reported on the 3x count either way.
 
@@ -235,25 +238,31 @@ def child_jax() -> None:
         # the timed region ends with a genuine device->host transfer of a
         # small output (not just block_until_ready, which this backend has
         # been observed resolving early on warm executables — PERF.md traps)
-        t0 = time.perf_counter()
+        from dorpatch_tpu import observe
+
+        timer = observe.StepTimer()
+        timer.start()
         for _ in range(reps):
             state = block(state, x, local_var_x, universe)
         jax.device_get(state.metrics)
-        step_seconds = (time.perf_counter() - t0) / (block_steps * reps)
+        dt = timer.stop()
+        step_seconds = dt / (block_steps * reps)
 
-        # MFU: useful FLOPs (fwd+bwd = 3x fwd, remat recompute excluded) per
-        # step over the chip's peak. The forward count is XLA's own cost
-        # model of the compiled victim, not a hand factor.
+        # MFU through the ONE shared formula (observe.StepTimer.summary):
+        # useful FLOPs per step = fwd+bwd = 3x fwd (remat recompute
+        # excluded), forward count from XLA's own cost model of the compiled
+        # victim, over the chip's peak.
         f_fwd = fwd_flops(victim, victim.params)
-        useful = 3.0 * f_fwd * batch * eot
         peak = _peak_tflops(jax.devices()) * 1e12
-        mfu = useful / step_seconds / peak if (f_fwd and peak) else None
+        s = timer.summary(steps_per_block=block_steps * reps, batch=batch,
+                          flops_per_step=3.0 * f_fwd * batch * eot,
+                          peak_flops=peak)
         return {
             "ips": batch / step_seconds,
             "batch": batch,
             "backend": jax.default_backend(),
             "remat": remat,
-            "mfu": round(mfu, 4) if mfu is not None else None,
+            "mfu": s.get("mfu"),
             "step_seconds": round(step_seconds, 4),
             "fwd_gflops_per_image": round(f_fwd / 1e9, 2) if f_fwd else None,
             # per-masked-sample throughput: the EOT batch is `eot` fwd+bwd
@@ -320,17 +329,22 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
         d.robust_predict(victim.params, x, victim.num_classes)
         log(f"warmup call {i}: {time.perf_counter() - t0:.2f}s")
 
+    from dorpatch_tpu import observe
+
     n_masks = d._rects.shape[0]
-    t0 = time.perf_counter()
+    timer = observe.StepTimer()
     for _ in range(reps):
         x = x * 0.999 + 0.0005
+        timer.start()
         d.robust_predict(victim.params, x, victim.num_classes)
-    # robust_predict materializes records via np.asarray: a real transfer
-    dt = (time.perf_counter() - t0) / reps
+        # robust_predict materializes records via np.asarray: a real transfer
+        timer.stop()
+    dt = sum(timer.block_seconds) / reps
 
-    # certify-mode MFU: forward-only FLOPs (XLA's own count at the chunked
-    # sweep's batch shape) x masked-forward rate over the chip peak; same
-    # guard as the attack child — unavailable cost model just omits it
+    # certify-mode MFU through the shared observe.StepTimer.summary formula:
+    # forward-only FLOPs (XLA's own count at the chunked sweep's batch
+    # shape) x masked-forward rate over the chip peak; same guard as the
+    # attack child — unavailable cost model just omits it
     mfu = None
     try:
         chunk = min(d.config.chunk_size, n_masks)
@@ -343,8 +357,9 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
             analysis = analysis[0]
         f_fwd = float(analysis["flops"]) / chunk
         peak = _peak_tflops(jax.devices()) * 1e12
-        if f_fwd and peak:
-            mfu = round(f_fwd * batch * n_masks / dt / peak, 4)
+        mfu = timer.summary(steps_per_block=1, batch=batch,
+                            flops_per_step=f_fwd * batch * n_masks,
+                            peak_flops=peak).get("mfu")
     except Exception as e:
         log(f"certify cost_analysis unavailable ({e}); mfu omitted")
     print(json.dumps({

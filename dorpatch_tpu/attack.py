@@ -39,6 +39,7 @@ import numpy as np
 
 from dorpatch_tpu import losses
 from dorpatch_tpu import masks as masks_lib
+from dorpatch_tpu import observe
 from dorpatch_tpu import ops
 from dorpatch_tpu.config import AttackConfig
 from dorpatch_tpu.defense import masked_predictions
@@ -467,7 +468,10 @@ class DorPatch:
                 state, _ = jax.lax.scan(body, state, None, length=n_steps)
                 return state
 
-            self._programs[key] = run_block
+            # telemetry: the first call pays trace+XLA compile; record it as
+            # a `compile` event on whatever EventLog the driver activated
+            self._programs[key] = observe.timed_first_call(
+                run_block, f"attack.block.stage{stage}.steps{n_steps}")
         return self._programs[key]
 
     def sweep_failures(self, adv_mask, adv_pattern, x, y, targeted, universe) -> jax.Array:
@@ -489,7 +493,8 @@ class DorPatch:
                 fail_per_img = jnp.where(targeted[:, None], ~hit, hit)
                 return jnp.any(fail_per_img, axis=0)
 
-            self._programs["sweep"] = sweep
+            self._programs["sweep"] = observe.timed_first_call(
+                sweep, "attack.sweep")
         return self._programs["sweep"](adv_mask, adv_pattern, x, y, targeted, universe)
 
     # ---------- host orchestration ----------
@@ -554,51 +559,54 @@ class DorPatch:
             return state  # e.g. resumed from a snapshot taken at early stop
 
         i = start_iter
-        while i < total:
-            # full failure sweep at every sweep_interval boundary (incl. i=0,
-            # `attack.py:187-190`)
-            failed = self.sweep_failures(
-                state.adv_mask, state.adv_pattern, x, state.y, state.targeted, universe
-            )
-            state = state._replace(failed=failed)
-
-            n_steps = min(interval, total - i)
-            if n_steps != interval:
-                block = self._get_block(stage, img_size, n_steps)
-            state = block(state, x, local_var_x, universe)
-            i += n_steps
-
-            # untargeted -> targeted switch at the boundary after
-            # switch_iteration steps (stage 0, `attack.py:169-182`)
-            if (
-                stage == 0
-                and i >= cfg.switch_iteration
-                and i - n_steps < cfg.switch_iteration
-                and not bool(jnp.all(state.targeted))
-            ):
-                y_new, has_target = majority_incorrect_label(
-                    state.last_preds, state.y, self.num_classes
+        with observe.span(f"attack.stage{stage}", start_iter=start_iter) as sp:
+            while i < total:
+                # full failure sweep at every sweep_interval boundary
+                # (incl. i=0, `attack.py:187-190`)
+                failed = self.sweep_failures(
+                    state.adv_mask, state.adv_pattern, x, state.y,
+                    state.targeted, universe
                 )
-                switch = has_target & (~state.targeted)
-                state = state._replace(
-                    targeted=state.targeted | switch,
-                    y=jnp.where(switch, y_new, state.y),
-                )
-                state = self._reset_schedules(state, n_universe)
+                state = state._replace(failed=failed)
 
-            # snapshot before the user callback, so a crash anywhere after
-            # the block computation resumes from this block
-            if self.checkpointer is not None:
-                s0 = stage0_artifacts or (None, None)
-                self.checkpointer.save(stage, i, state, s0[0], s0[1])
-            if self.on_block_end is not None:
-                self.on_block_end(stage, i, {
-                    "metrics": np.asarray(state.metrics),
-                    "stopped": bool(state.stopped),
-                    "n_failed": int(np.asarray(state.metrics)[7]),
-                })
-            if bool(state.stopped):
-                break
+                n_steps = min(interval, total - i)
+                if n_steps != interval:
+                    block = self._get_block(stage, img_size, n_steps)
+                state = block(state, x, local_var_x, universe)
+                i += n_steps
+
+                # untargeted -> targeted switch at the boundary after
+                # switch_iteration steps (stage 0, `attack.py:169-182`)
+                if (
+                    stage == 0
+                    and i >= cfg.switch_iteration
+                    and i - n_steps < cfg.switch_iteration
+                    and not bool(jnp.all(state.targeted))
+                ):
+                    y_new, has_target = majority_incorrect_label(
+                        state.last_preds, state.y, self.num_classes
+                    )
+                    switch = has_target & (~state.targeted)
+                    state = state._replace(
+                        targeted=state.targeted | switch,
+                        y=jnp.where(switch, y_new, state.y),
+                    )
+                    state = self._reset_schedules(state, n_universe)
+
+                # snapshot before the user callback, so a crash anywhere
+                # after the block computation resumes from this block
+                if self.checkpointer is not None:
+                    s0 = stage0_artifacts or (None, None)
+                    self.checkpointer.save(stage, i, state, s0[0], s0[1])
+                if self.on_block_end is not None:
+                    self.on_block_end(stage, i, {
+                        "metrics": np.asarray(state.metrics),
+                        "stopped": bool(state.stopped),
+                        "n_failed": int(np.asarray(state.metrics)[7]),
+                    })
+                if bool(state.stopped):
+                    break
+            sp["end_iter"] = i
         return state
 
     def generate(

@@ -17,7 +17,7 @@ from typing import Dict, List
 import numpy as np
 import torch
 
-from dorpatch_tpu import metrics
+from dorpatch_tpu import metrics, observe
 from dorpatch_tpu.artifacts import ArtifactStore, results_path, write_config_record
 from dorpatch_tpu.backends.torch_attack import (
     TorchDorPatch,
@@ -65,6 +65,12 @@ def run_experiment_torch(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
     model = get_torch_victim(cfg)
     store = ArtifactStore(results_path(cfg))
     write_config_record(cfg, store.result_dir)
+    # run.json keeps the results dir self-describing on this backend too
+    # (no jax environment blurb: this path must never touch jax)
+    observe.write_run_manifest(
+        store.result_dir, cfg, run_id=observe.new_run_id(),
+        extra={"backend_impl": "torch", "backend": "torch-cpu",
+               "torch": torch.__version__})
     defenses = build_torch_defenses(model, cfg.img_size, cfg.defense)
     attack = TorchDorPatch(model, cfg.num_classes, cfg.attack)
 
@@ -156,8 +162,8 @@ def run_experiment_torch(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             preds_adv_list.append(model(adv_x).argmax(-1).numpy())
         records.extend(recs)
         if verbose:
-            print(f"batch {i}: {len(y_np)} imgs in {time.time() - t0:.1f}s",
-                  flush=True)
+            observe.log(
+                f"batch {i}: {len(y_np)} imgs in {time.time() - t0:.1f}s")
 
     if not preds_list:
         empty = {"clean_accuracy": 0.0, "robust_accuracy": 0.0,
@@ -165,7 +171,7 @@ def run_experiment_torch(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                  "evaluated_images": 0,
                  "report": "no correctly-classified images evaluated"}
         if verbose:
-            print(empty["report"])
+            observe.log(empty["report"])
         return empty
     preds_clean = np.concatenate(preds_list)
     y_all = np.concatenate(y_list)
@@ -183,7 +189,7 @@ def run_experiment_torch(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             generated_images / sum(attack_seconds), 4)
     m["report"] = metrics.report_line(m)
     if verbose:
-        print(m["report"])
+        observe.log(m["report"])
     return m
 
 

@@ -67,7 +67,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-dir", default="",
                    help="write a jax.profiler trace of the run here")
     p.add_argument("--no-metrics-log", action="store_true",
-                   help="disable the structured metrics JSONL in the results dir")
+                   help="disable run telemetry (metrics JSONL, events "
+                        "JSONL span log, heartbeats) in the results dir")
+    p.add_argument("--hang-timeout", type=float, default=0.0,
+                   help="seconds without telemetry progress before the "
+                        "watchdog prints every process's last-known phase "
+                        "and aborts (0 = disabled); must exceed the longest "
+                        "single jitted block including its compile; "
+                        "requires telemetry (no effect with --no-metrics-log)")
+    p.add_argument("--heartbeat-interval", type=float, default=5.0,
+                   help="seconds between heartbeat_<proc>.jsonl beats")
     p.add_argument("--carry-checkpoints", action="store_true",
                    help="orbax-checkpoint the optimizer carry every sweep "
                         "block (mid-stage crash recovery)")
@@ -149,6 +158,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         mesh_mask=args.mesh_mask,
         metrics_log=not args.no_metrics_log,
         trace_dir=args.trace_dir,
+        hang_timeout=args.hang_timeout,
+        heartbeat_interval=args.heartbeat_interval,
         carry_checkpoints=args.carry_checkpoints,
         attack=attack,
         defense=DefenseConfig(use_pallas=args.use_pallas,

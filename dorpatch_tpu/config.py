@@ -174,10 +174,19 @@ class ExperimentConfig:
     mesh_data: int = 1
     mesh_mask: int = 1
 
-    # Observability (SURVEY.md §5): structured metrics JSONL under the
-    # results dir, optional jax.profiler trace dir.
+    # Observability (SURVEY.md §5): metrics_log is the master telemetry
+    # switch — it gates the metrics JSONL *and* the run telemetry files
+    # (events.jsonl spans, heartbeat_<proc>.jsonl) the offline report CLI
+    # consumes (`observe/report.py`). run.json is always written (a results
+    # dir must stay self-describing even with telemetry off).
     metrics_log: bool = True
     trace_dir: str = ""
+    heartbeat_interval: float = 5.0  # seconds between heartbeat beats
+    hang_timeout: float = 0.0       # >0 arms the watchdog: abort (with every
+                                    # process's last-known phase) instead of
+                                    # hanging forever on a wedged collective.
+                                    # Must exceed the longest single jitted
+                                    # block INCLUDING its compile.
 
     # Mid-stage orbax checkpoints of the optimizer carry (crash recovery
     # finer than the reference's per-stage artifacts, SURVEY.md §5).

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dorpatch_tpu import masks as masks_lib
+from dorpatch_tpu import observe
 from dorpatch_tpu import ops
 from dorpatch_tpu.config import DefenseConfig
 
@@ -266,8 +267,11 @@ class PatchCleanser:
             from jax.sharding import NamedSharding, PartitionSpec
 
             out_shardings = NamedSharding(self.mesh, PartitionSpec())
-        self._predict = jax.jit(_predict, static_argnums=2,
-                                out_shardings=out_shardings)
+        # telemetry: first call = trace + XLA compile of the whole 666-mask
+        # sweep; recorded as a `compile` event on the driver's EventLog
+        self._predict = observe.timed_first_call(
+            jax.jit(_predict, static_argnums=2, out_shardings=out_shardings),
+            f"defense.predict.r{self.spec.patch_ratio}")
 
     def robust_predict(
         self, params, imgs: jax.Array, num_classes: int

@@ -35,6 +35,8 @@ import sys
 
 import numpy as np
 
+from dorpatch_tpu import observe
+
 
 def _infer_arch(path: str) -> str:
     base = os.path.basename(path)
@@ -162,7 +164,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if not os.path.exists(args.checkpoint):
-        print(f"checkpoint not found: {args.checkpoint}", file=sys.stderr)
+        observe.log(f"checkpoint not found: {args.checkpoint}", file=sys.stderr)
         return 2
     if args.keys_only:
         report = verify_keys(
@@ -173,14 +175,14 @@ def main(argv=None) -> int:
         drift = (report["missing"] or report["unexpected"]
                  or report["shape_drift"])
         verdict = "FAIL" if drift else "OK"
-        print(f"[{verdict}] {report['arch']}: {report['n_keys']} keys vs "
+        observe.log(f"[{verdict}] {report['arch']}: {report['n_keys']} keys vs "
               f"vendored timm-0.6.7 contract — "
               f"{len(report['missing'])} missing, "
               f"{len(report['unexpected'])} unexpected, "
               f"{len(report['shape_drift'])} shape-drifted")
         for field in ("missing", "unexpected", "shape_drift"):
             for item in report[field][:20]:
-                print(f"  {field}: {item}")
+                observe.log(f"  {field}: {item}")
         return 1 if drift else 0
     arch = args.arch or _infer_arch(args.checkpoint)
     img_size = args.img_size or (
@@ -193,12 +195,12 @@ def main(argv=None) -> int:
     )
     ok = report["max_abs_delta"] <= args.tol and report["argmax_agree"]
     verdict = "OK" if ok else "FAIL"
-    print(f"[{verdict}] {report['arch']} ({report['dataset']}): "
+    observe.log(f"[{verdict}] {report['arch']} ({report['dataset']}): "
           f"max |logit delta| = {report['max_abs_delta']:.3e} "
           f"(tol {args.tol:g}), argmax agree = {report['argmax_agree']}, "
           f"{report['n_params']} converted param leaves")
     if not ok:
-        print(f"per-image max deltas: {report['per_image_delta']}")
+        observe.log(f"per-image max deltas: {report['per_image_delta']}")
     return 0 if ok else 1
 
 

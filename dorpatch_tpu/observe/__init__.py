@@ -1,0 +1,93 @@
+"""Observability subsystem: run telemetry for the whole framework.
+
+The reference's only observability is tqdm plus a print of the loss
+breakdown every 20 iterations (`/root/reference/attack.py:318-330`) and
+per-run result prints (`main.py:186-187`). Here that is a real telemetry
+layer; every results dir carries a self-describing contract:
+
+- `run.json`            — run manifest (`manifest.py`): resolved config,
+  jax/jaxlib versions, device kind/topology, process count, hostname,
+  git SHA, per-attempt run_id.
+- `metrics.jsonl`       — the attack's on-device metrics vector per jitted
+  block (`attack_log.AttackMetricsLogger`), run_id-stamped per attempt.
+- `events.jsonl`        — per-process span/event log (`events.EventLog`):
+  nested spans (`run`, `batch`, `attack.stage0/1`, `certify`,
+  `artifact_io`, ...), jit-compile durations, device-memory samples.
+- `heartbeat_<proc>.jsonl` — daemon-thread heartbeats per process
+  (`heartbeat.Heartbeat`), the post-mortem for hung collectives; the
+  `--hang-timeout` watchdog (`heartbeat.Watchdog`) aborts instead of
+  hanging forever.
+
+`python -m dorpatch_tpu.observe.report <results_dir>` joins all of it into
+a human summary (`report.py`). `StepTimer`/`trace` (`timing.py`) and
+`console.log` round out the surface. Every name that predates the package
+(`AttackMetricsLogger`, `StepTimer`, `trace`, `METRIC_NAMES`) stays
+importable from `dorpatch_tpu.observe`.
+"""
+
+from dorpatch_tpu.observe.attack_log import (  # noqa: F401
+    METRIC_NAMES,
+    AttackMetricsLogger,
+)
+from dorpatch_tpu.observe.console import (  # noqa: F401
+    elapsed,
+    log,
+    process_index,
+    set_process_index,
+)
+from dorpatch_tpu.observe.events import (  # noqa: F401
+    EventLog,
+    active,
+    active_event_log,
+    device_memory_stats,
+    events_filename,
+    record_compile,
+    record_event,
+    span,
+    timed_first_call,
+)
+from dorpatch_tpu.observe.heartbeat import (  # noqa: F401
+    Heartbeat,
+    Watchdog,
+    heartbeat_filename,
+    heartbeat_gaps,
+    read_heartbeats,
+    summarize_heartbeats,
+)
+from dorpatch_tpu.observe.manifest import (  # noqa: F401
+    jax_environment,
+    new_run_id,
+    run_manifest,
+    write_run_manifest,
+)
+from dorpatch_tpu.observe.timing import StepTimer, trace  # noqa: F401
+
+__all__ = [
+    "METRIC_NAMES",
+    "AttackMetricsLogger",
+    "EventLog",
+    "Heartbeat",
+    "StepTimer",
+    "Watchdog",
+    "active",
+    "active_event_log",
+    "device_memory_stats",
+    "elapsed",
+    "events_filename",
+    "heartbeat_filename",
+    "heartbeat_gaps",
+    "jax_environment",
+    "log",
+    "new_run_id",
+    "process_index",
+    "read_heartbeats",
+    "record_compile",
+    "record_event",
+    "run_manifest",
+    "set_process_index",
+    "span",
+    "summarize_heartbeats",
+    "timed_first_call",
+    "trace",
+    "write_run_manifest",
+]

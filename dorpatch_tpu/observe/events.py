@@ -1,0 +1,289 @@
+"""Span/event log: nested spans, jit-compile counters, device-memory samples.
+
+One `events.jsonl` per process under the results dir (`events_filename`).
+Every record carries a wall timestamp, the process index, the per-attempt
+`run_id`, and a monotonically increasing `seq` — so records from a resumed
+run group by attempt and a post-mortem reader can totally order one
+process's telemetry even when wall clocks step.
+
+Record kinds:
+
+- `begin` / `span`  — a `begin` is written when a span opens, the matching
+  `span` (with `dur_s` and any attributes) when it closes. A `begin` with
+  no closing `span` is the signature of a hang: the last open path IS the
+  phase the process died in (see `heartbeat.Watchdog`).
+- `block`           — one jitted attack block finished (`DorPatch.on_block_end`
+  boundary): stage, cumulative step, wall duration since the previous
+  telemetry mark, and a `device.memory_stats()` sample when the backend
+  provides one.
+- `compile`         — first call of a jitted entry point (`timed_first_call`
+  wraps the attack/defense jit programs), i.e. compile + first dispatch
+  wall time. The report CLI sums these into compile-vs-run accounting.
+- `event`           — free-form point event.
+
+The module-level `span()` / `record_event()` / `record_compile()` helpers
+delegate to the process's ACTIVE EventLog and no-op when none is installed,
+so the attack/defense/train layers can emit telemetry without holding a
+reference to (or even knowing about) the sink the driver configured.
+
+Spans are main-thread only (the stack is per-process, not per-thread); the
+heartbeat thread only *reads* `current_path()` under the lock.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import sys
+import threading
+import time
+from typing import IO, List, Optional
+
+
+def events_filename(process_index: int = 0) -> str:
+    """Per-process event-log name; process 0 keeps the bare `events.jsonl`."""
+    return ("events.jsonl" if process_index == 0
+            else f"events_{process_index}.jsonl")
+
+
+def device_memory_stats() -> Optional[List[dict]]:
+    """Per-device `memory_stats()` sample, or None when unavailable.
+
+    Reads jax from `sys.modules` instead of importing it: a host-only
+    consumer (the report CLI, the torch backend) must never initialize the
+    accelerator backend as a side effect of telemetry. CPU devices without
+    allocator stats simply yield nothing."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return None
+    out = []
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        rec = {"device": int(getattr(d, "id", -1))}
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in ms:
+                rec[k] = int(ms[k])
+        out.append(rec)
+    return out or None
+
+
+class EventLog:
+    """Append-mode JSONL event sink with a nested-span stack.
+
+    An unwritable results dir degrades to a no-file sink that still tracks
+    the span stack (the heartbeat's phase and the watchdog's activity clock
+    keep working; only persistence is lost) — same contract as the
+    pipeline's best-effort `summary.json` write."""
+
+    def __init__(self, path: Optional[str], run_id: str = "",
+                 process_index: int = 0, clock=time.time,
+                 perf=time.perf_counter):
+        self.path = path
+        self.run_id = run_id
+        self.process_index = process_index
+        self._clock = clock
+        self._perf = perf
+        self._lock = threading.RLock()
+        self._seq = 0
+        self._stack = []  # [(name, perf_t0)]
+        self._last_mark = perf()
+        self._last_activity = perf()
+        self._fh: Optional[IO[str]] = None
+        if path:
+            try:
+                os.makedirs(os.path.dirname(os.path.abspath(path)),
+                            exist_ok=True)
+                self._fh = open(path, "a", buffering=1)
+            except OSError:
+                self._fh = None
+
+    # ---------------- record plumbing ----------------
+
+    def _write(self, kind: str, name: Optional[str] = None, **fields) -> dict:
+        with self._lock:
+            rec = {"ts": round(self._clock(), 3), "seq": self._seq,
+                   "proc": self.process_index, "run_id": self.run_id,
+                   "kind": kind}
+            if name is not None:
+                rec["name"] = name
+            rec.update(fields)
+            self._seq += 1
+            self._last_activity = self._perf()
+            if self._fh is not None:
+                try:
+                    self._fh.write(json.dumps(rec, default=float) + "\n")
+                except OSError:
+                    # disk full / quota mid-run: telemetry must never take
+                    # down the computation it observes — drop to the
+                    # tracking-only sink (same contract as a failed open)
+                    try:
+                        self._fh.close()
+                    except OSError:
+                        pass
+                    self._fh = None
+            return rec
+
+    def seconds_since_activity(self) -> float:
+        """Seconds since the main thread last wrote any record — the
+        watchdog's liveness signal. Heartbeat beats deliberately do NOT
+        count: they prove the process is alive, not that it progresses."""
+        with self._lock:
+            return self._perf() - self._last_activity
+
+    def current_path(self) -> str:
+        """`run/batch/attack.stage1`-style phase path (heartbeat payload)."""
+        with self._lock:
+            return "/".join(n for n, _ in self._stack) or "idle"
+
+    # ---------------- span / event API ----------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Nested span; yields a mutable attrs dict — entries added inside
+        the scope (e.g. the batch size discovered mid-span) land on the
+        closing `span` record."""
+        with self._lock:
+            t0 = self._perf()
+            self._stack.append((name, t0))
+            depth = len(self._stack) - 1
+            path = "/".join(n for n, _ in self._stack)
+            self._last_mark = t0
+        self._write("begin", name, path=path, depth=depth)
+        out_attrs = dict(attrs)
+        try:
+            yield out_attrs
+        finally:
+            t1 = self._perf()
+            with self._lock:
+                self._stack.pop()
+                self._last_mark = t1
+            self._write("span", name, path=path, depth=depth,
+                        dur_s=round(t1 - t0, 6), **out_attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        self._write("event", name, **attrs)
+
+    def compile(self, name: str, seconds: float) -> None:
+        self._write("compile", name, dur_s=round(seconds, 6))
+
+    def block_boundary(self, stage: int, step: int,
+                       info: Optional[dict] = None) -> None:
+        """One attack block finished: duration since the previous telemetry
+        mark (span edge or block) plus a device-memory sample."""
+        with self._lock:
+            now = self._perf()
+            dur = now - self._last_mark
+            self._last_mark = now
+        fields = {"stage": int(stage), "step": int(step),
+                  "dur_s": round(dur, 6)}
+        if info is not None:
+            fields["stopped"] = bool(info.get("stopped", False))
+        mem = device_memory_stats()
+        if mem:
+            fields["mem"] = mem
+        self._write("block", None, **fields)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------- process-wide active log ----------------
+
+_ACTIVE: Optional[EventLog] = None
+
+
+def active_event_log() -> Optional[EventLog]:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def active(elog: Optional[EventLog]):
+    """Install `elog` as the process's active sink for the scope (None is a
+    legal no-op, so callers don't need to branch on telemetry being off)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = elog
+    try:
+        yield elog
+    finally:
+        _ACTIVE = prev
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Span against the active EventLog; no-op when telemetry is off.
+
+    Always yields a dict callers may add attributes to — a throwaway one
+    when no log is active, so call sites never branch on telemetry."""
+    el = _ACTIVE
+    if el is None:
+        yield dict(attrs)
+        return
+    with el.span(name, **attrs) as out_attrs:
+        yield out_attrs
+
+
+def record_event(name: str, **attrs) -> None:
+    el = _ACTIVE
+    if el is not None:
+        el.event(name, **attrs)
+
+
+def record_compile(name: str, seconds: float) -> None:
+    el = _ACTIVE
+    if el is not None:
+        el.compile(name, seconds)
+
+
+class _FirstCallTimer:
+    """Callable proxy recording the wrapped fn's first-call wall time as a
+    `compile` event. Unknown attributes delegate to the wrapped callable, so
+    a wrapped `jax.jit` object keeps its full API (`.lower()`, `.trace()`,
+    ... — the HLO-inspection tests and tools rely on it)."""
+
+    def __init__(self, fn, name: str, clock):
+        self.__wrapped__ = fn
+        self._name = name
+        self._clock = clock
+        self._done = False
+        functools.update_wrapper(self, fn, updated=())
+
+    def __call__(self, *args, **kwargs):
+        if self._done:
+            return self.__wrapped__(*args, **kwargs)
+        self._done = True
+        t0 = self._clock()
+        out = self.__wrapped__(*args, **kwargs)
+        record_compile(self._name, self._clock() - t0)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self.__wrapped__, item)
+
+
+def timed_first_call(fn, name: str, clock=time.perf_counter):
+    """Wrap a jitted callable so its FIRST invocation's wall time is
+    recorded as a `compile` event (trace + XLA compile happen synchronously
+    inside that call; execution dispatch is the tail). Subsequent calls pass
+    through untimed. Recording goes to whatever EventLog is active at
+    first-call time — none active, nothing recorded."""
+    return _FirstCallTimer(fn, name, clock)

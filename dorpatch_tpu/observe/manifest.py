@@ -1,0 +1,127 @@
+"""Run manifest: `run.json` makes every results dir self-describing.
+
+`config.json` (artifacts.write_config_record) records WHAT was asked for;
+`run.json` records what actually RAN it — library versions, device kind and
+topology, process count, hostname, git SHA — plus the per-attempt `run_id`
+that stamps every metrics/events/heartbeat record. Resumed runs overwrite
+`run.json` with the newest attempt but chain the older ids into
+`previous_run_ids`, so the report CLI can enumerate attempts even before
+reading the JSONL files.
+
+Host-only by construction: nothing here imports jax/torch. The jax pipeline
+passes `jax_environment()` (which reads the live backend) as `extra`; the
+torch pipeline passes its own backend blurb.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import platform
+import socket
+import subprocess
+import sys
+import time
+import uuid
+from typing import Optional
+
+MANIFEST_NAME = "run.json"
+
+
+def new_run_id() -> str:
+    """Per-process, per-attempt id stamped onto every telemetry record."""
+    return uuid.uuid4().hex[:12]
+
+
+def git_sha() -> Optional[str]:
+    """Best-effort SHA of the checkout this package runs from."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=5)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def jax_environment() -> dict:
+    """Backend/topology blurb for the manifest — call ONLY from code that
+    already owns the jax backend (touching `jax.devices()` initializes it)."""
+    import jax
+
+    info = {"backend_impl": "jax", "jax": jax.__version__}
+    try:
+        import jaxlib
+
+        info["jaxlib"] = getattr(
+            jaxlib, "__version__",
+            getattr(getattr(jaxlib, "version", None), "__version__", None))
+    except Exception:
+        pass
+    try:
+        devs = jax.devices()
+        info.update({
+            "backend": jax.default_backend(),
+            "device_kind": str(devs[0].device_kind) if devs else "",
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "process_count": jax.process_count(),
+            "process_index": jax.process_index(),
+        })
+    except Exception as e:  # backend refused to come up: record why
+        info["backend_error"] = repr(e)
+    return info
+
+
+def run_manifest(cfg=None, run_id: str = "", extra: Optional[dict] = None,
+                 clock=time.time) -> dict:
+    """Assemble the manifest dict (pure; `write_run_manifest` persists it)."""
+    m = {
+        "schema": 1,
+        "run_id": run_id,
+        "started_ts": round(clock(), 3),
+        "started_iso": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "hostname": socket.gethostname(),
+        "pid": os.getpid(),
+        "platform": platform.platform(),
+        "python": sys.version.split()[0],
+        "argv": list(sys.argv),
+    }
+    sha = git_sha()
+    if sha:
+        m["git_sha"] = sha
+    if cfg is not None:
+        m["config"] = (dataclasses.asdict(cfg)
+                       if dataclasses.is_dataclass(cfg) else dict(cfg))
+    if extra:
+        m.update(extra)
+    return m
+
+
+def write_run_manifest(result_dir: str, cfg=None, run_id: str = "",
+                       extra: Optional[dict] = None) -> Optional[str]:
+    """Write `run.json` at experiment start; returns its path (None when the
+    results dir is read-only — telemetry must never fail the run). A prior
+    manifest's run_id is chained into `previous_run_ids`."""
+    path = os.path.join(result_dir, MANIFEST_NAME)
+    previous = []
+    try:
+        with open(path) as fh:
+            old = json.load(fh)
+        previous = [old["run_id"]] if old.get("run_id") else []
+        previous += list(old.get("previous_run_ids", []))
+    except (OSError, ValueError, KeyError):
+        pass
+    m = run_manifest(cfg, run_id=run_id, extra=extra)
+    if previous:
+        m["previous_run_ids"] = previous
+    try:
+        os.makedirs(result_dir, exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(m, fh, indent=1, default=float)
+    except OSError:
+        return None
+    return path

@@ -1,0 +1,339 @@
+"""Offline telemetry report: join `run.json` + `events.jsonl` +
+`metrics.jsonl` + `heartbeat_*.jsonl` into a human summary.
+
+    python -m dorpatch_tpu.observe.report <results_dir> [--json]
+
+Host-only: parses JSONL, never imports jax/torch — safe to run on a login
+node against a results dir a wedged TPU job left behind. Shows, per the
+latest attempt (run_id): the per-phase time breakdown and span coverage,
+compile vs run time, attack/certification throughput (MFU via the shared
+`StepTimer.summary` FLOPs path when the manifest carries FLOPs accounting),
+device-memory peaks, heartbeat stall detection, and spans left open by a
+hang or crash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+from typing import Dict, List, Optional
+
+from dorpatch_tpu.observe.heartbeat import summarize_heartbeats
+from dorpatch_tpu.observe.manifest import MANIFEST_NAME
+from dorpatch_tpu.observe.timing import StepTimer
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    rows = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue  # truncated tail of an aborted run
+    except OSError:
+        pass
+    return rows
+
+
+def load_manifest(result_dir: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(result_dir, MANIFEST_NAME)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def load_events(result_dir: str) -> List[dict]:
+    rows = []
+    for path in sorted(glob.glob(os.path.join(result_dir, "events*.jsonl"))):
+        rows.extend(_read_jsonl(path))
+    return rows
+
+
+def _aggregate(spans: List[dict]) -> List[dict]:
+    """[{name, count, total_s}] sorted by total descending."""
+    agg: Dict[str, dict] = {}
+    for s in spans:
+        a = agg.setdefault(s.get("name", "?"),
+                           {"name": s.get("name", "?"), "count": 0,
+                            "total_s": 0.0})
+        a["count"] += 1
+        a["total_s"] += float(s.get("dur_s", 0.0))
+    out = sorted(agg.values(), key=lambda a: -a["total_s"])
+    for a in out:
+        a["total_s"] = round(a["total_s"], 3)
+    return out
+
+
+def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
+    """Join every telemetry file in `result_dir` into one summary dict."""
+    manifest = load_manifest(result_dir)
+    events = load_events(result_dir)
+    metrics = _read_jsonl(os.path.join(result_dir, "metrics.jsonl"))
+
+    attempts: List[str] = list((manifest or {}).get("previous_run_ids", []))[::-1]
+    for r in metrics + events:
+        rid = r.get("run_id", "")
+        if rid and rid not in attempts:
+            attempts.append(rid)
+    run_id = (manifest or {}).get("run_id") or (attempts[-1] if attempts else "")
+
+    # latest attempt, driver process only, for the time accounting
+    ev = [r for r in events
+          if r.get("proc", 0) == 0 and r.get("run_id", "") == run_id]
+    spans = [r for r in ev if r.get("kind") == "span"]
+    begins = [r for r in ev if r.get("kind") == "begin"]
+    compiles = [r for r in ev if r.get("kind") == "compile"]
+    blocks = [r for r in ev if r.get("kind") == "block"]
+
+    # run wall time: the closing "run" span, else (hang/crash) the distance
+    # from its begin record to the last record seen
+    run_spans = [s for s in spans if s.get("name") == "run"]
+    run_complete = bool(run_spans)
+    if run_spans:
+        run_seconds = float(run_spans[-1]["dur_s"])
+    else:
+        run_begin = [b for b in begins if b.get("name") == "run"]
+        run_seconds = (float(ev[-1]["ts"]) - float(run_begin[-1]["ts"])
+                       if run_begin and ev else 0.0)
+
+    top = [s for s in spans if s.get("depth") == 1]
+    phases = _aggregate(top)
+    covered = sum(p["total_s"] for p in phases)
+    for p in phases:
+        p["pct"] = round(100.0 * p["total_s"] / run_seconds, 1) \
+            if run_seconds else 0.0
+    inner = _aggregate([s for s in spans if s.get("depth", 0) >= 2])
+
+    # spans left open: begin paths minus closed span paths (multiset)
+    closed: Dict[str, int] = {}
+    for s in spans:
+        closed[s.get("path", "")] = closed.get(s.get("path", ""), 0) + 1
+    open_spans = []
+    for b in begins:
+        p = b.get("path", "")
+        if closed.get(p, 0) > 0:
+            closed[p] -= 1
+        else:
+            open_spans.append(p)
+
+    compile_total = round(sum(float(c.get("dur_s", 0.0)) for c in compiles), 3)
+
+    # attack accounting: steps from metrics.jsonl (max step per batch/stage),
+    # seconds from the attack.stage* spans, images from batch-span attrs
+    mrecs = [m for m in metrics if m.get("run_id", run_id) == run_id]
+    steps_by_key: Dict[tuple, int] = {}
+    for m in mrecs:
+        key = (m.get("batch", 0), m.get("stage", 0))
+        steps_by_key[key] = max(steps_by_key.get(key, 0), int(m.get("step", 0)))
+    attack_steps = sum(steps_by_key.values())
+    attack_seconds = sum(float(s.get("dur_s", 0.0)) for s in spans
+                         if str(s.get("name", "")).startswith("attack.stage"))
+    batch_spans = [s for s in spans if s.get("name") == "batch"]
+    images_total = sum(int(s.get("images", 0)) for s in batch_spans)
+    images_generated = sum(int(s.get("images", 0)) for s in batch_spans
+                           if not s.get("cached"))
+    # certify accounting from the certify spans themselves: on resumed runs
+    # cached batches skip certification entirely, so dividing ALL images by
+    # certify time would inflate the rate
+    certify_spans = [s for s in spans if s.get("name") == "certify"]
+    certify_seconds = sum(float(s.get("dur_s", 0.0)) for s in certify_spans)
+    certify_images = sum(int(s.get("images", 0)) for s in certify_spans)
+
+    peak_mem = 0
+    for b in blocks:
+        for d in b.get("mem") or []:
+            peak_mem = max(peak_mem,
+                           int(d.get("peak_bytes_in_use",
+                                     d.get("bytes_in_use", 0)) or 0))
+
+    # MFU through the one shared formula (StepTimer.summary): available when
+    # the manifest records FLOPs accounting (e.g. a bench-style run)
+    mfu = None
+    tele = (manifest or {}).get("telemetry") or {}
+    if attack_steps and attack_seconds and tele.get("flops_per_step") \
+            and tele.get("peak_flops"):
+        t = StepTimer()
+        t.block_seconds = [attack_seconds]
+        mfu = t.summary(steps_per_block=attack_steps, batch=1,
+                        flops_per_step=float(tele["flops_per_step"]),
+                        peak_flops=float(tele["peak_flops"]))
+
+    metrics_by_attempt: Dict[str, int] = {}
+    for m in metrics:
+        rid = m.get("run_id", "(unstamped)")
+        metrics_by_attempt[rid] = metrics_by_attempt.get(rid, 0) + 1
+
+    return {
+        "result_dir": result_dir,
+        "manifest": manifest,
+        "run_id": run_id,
+        "attempts": attempts,
+        "run_complete": run_complete,
+        "run_seconds": round(run_seconds, 3),
+        "phases": phases,
+        "coverage": round(covered / run_seconds, 4) if run_seconds else 0.0,
+        "inner_spans": inner,
+        "open_spans": open_spans,
+        "compile": {"total_s": compile_total, "programs": _aggregate(compiles)},
+        "blocks": {"count": len(blocks),
+                   "total_s": round(sum(float(b.get("dur_s", 0.0))
+                                        for b in blocks), 3)},
+        "attack": {
+            "steps": attack_steps,
+            "seconds": round(attack_seconds, 3),
+            "steps_per_sec": round(attack_steps / attack_seconds, 3)
+            if attack_seconds else 0.0,
+            "images": images_total,
+            "images_generated": images_generated,
+            "images_per_sec": round(images_generated / attack_seconds, 3)
+            if attack_seconds and images_generated else 0.0,
+        },
+        "certify": {
+            "seconds": round(certify_seconds, 3),
+            "images": certify_images,
+            "images_per_sec": round(certify_images / certify_seconds, 3)
+            if certify_seconds and certify_images else 0.0,
+        },
+        "mfu": mfu,
+        "peak_device_bytes": peak_mem or None,
+        "heartbeats": summarize_heartbeats(result_dir,
+                                           stall_factor=stall_factor),
+        "metrics_records": {"total": len(metrics),
+                            "by_attempt": metrics_by_attempt},
+    }
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024.0
+    return f"{n} B"
+
+
+def format_report(s: dict) -> str:
+    """Human rendering of a `summarize()` dict."""
+    lines = []
+    add = lines.append
+    add("= DorPatch run telemetry report =")
+    add(f"results dir: {s['result_dir']}")
+    m = s.get("manifest") or {}
+    attempt = (f"attempt {len(s['attempts'])}" if len(s["attempts"]) > 1
+               else "single attempt")
+    add(f"run: {s['run_id'] or '(no run_id)'} ({attempt})"
+        + (f" started {m['started_iso']}" if m.get("started_iso") else "")
+        + (f" on {m['hostname']}" if m.get("hostname") else "")
+        + (f" @ {m['git_sha'][:10]}" if m.get("git_sha") else ""))
+    if m.get("backend") or m.get("jax"):
+        add(f"backend: {m.get('backend', '?')} "
+            f"({m.get('device_count', '?')} x {m.get('device_kind', '?')}, "
+            f"{m.get('process_count', '?')} process(es)) "
+            f"jax {m.get('jax', '?')}")
+    if not s["run_complete"]:
+        add("!! run span never closed: the run hung or crashed mid-flight")
+
+    add(f"-- phase breakdown (proc 0, run {s['run_seconds']}s) --")
+    for p in s["phases"]:
+        add(f"  {p['name']:<14} {p['total_s']:>9.3f}s  {p['pct']:>5.1f}%  "
+            f"({p['count']} span{'s' if p['count'] != 1 else ''})")
+    add(f"  span coverage: {100.0 * s['coverage']:.1f}% of run wall time")
+    if s["inner_spans"]:
+        add("-- inner spans --")
+        for p in s["inner_spans"]:
+            add(f"  {p['name']:<24} {p['total_s']:>9.3f}s  ({p['count']})")
+    if s["open_spans"]:
+        add("-- spans left OPEN (hang/crash signature) --")
+        for p in s["open_spans"]:
+            add(f"  {p}")
+
+    c = s["compile"]
+    add("-- compile --")
+    pct = (100.0 * c["total_s"] / s["run_seconds"]) if s["run_seconds"] else 0.0
+    add(f"  compile time: {c['total_s']}s ({pct:.1f}% of run) over "
+        f"{len(c['programs'])} program(s)")
+    for p in c["programs"]:
+        add(f"  {p['name']:<36} {p['count']} x {p['total_s']:.3f}s")
+
+    a, ce = s["attack"], s["certify"]
+    add("-- throughput --")
+    add(f"  attack: {a['steps']} steps in {a['seconds']}s -> "
+        f"{a['steps_per_sec']} steps/sec; {a['images_generated']} images "
+        f"generated -> {a['images_per_sec']} images/sec")
+    add(f"  certify: {ce['images']} images in {ce['seconds']}s -> "
+        f"{ce['images_per_sec']} images/sec")
+    if s["mfu"]:
+        add(f"  mfu: {s['mfu'].get('mfu')} "
+            f"({s['mfu'].get('achieved_tflops')} TFLOP/s achieved)")
+    else:
+        add("  mfu: n/a (no FLOPs accounting in run.json:telemetry)")
+    if s["peak_device_bytes"]:
+        add(f"  peak device memory: {_fmt_bytes(s['peak_device_bytes'])}")
+
+    add("-- heartbeats --")
+    if not s["heartbeats"]:
+        add("  (no heartbeat files)")
+    for h in s["heartbeats"]:
+        if not h.get("beats"):
+            add(f"  {h['file']}: empty")
+            continue
+        flag = "  ** STALL **" if h.get("stalled") else ""
+        exit_ = "clean exit" if h.get("clean_exit") else \
+            f"last phase {h.get('last_phase', '')!r}"
+        add(f"  {h['file']}: {h['beats']} beats, {exit_}, "
+            f"median gap {h.get('median_gap_s')}s, "
+            f"max {h.get('max_gap_s')}s{flag}")
+
+    mr = s["metrics_records"]
+    add("-- metrics.jsonl --")
+    if mr["total"]:
+        parts = ", ".join(f"{rid}: {n}" for rid, n in mr["by_attempt"].items())
+        add(f"  {mr['total']} records across {len(mr['by_attempt'])} "
+            f"attempt(s) ({parts})")
+    else:
+        add("  (no metrics records)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dorpatch_tpu.observe.report",
+        description="Offline telemetry report for a DorPatch results dir")
+    p.add_argument("result_dir", help="results dir holding run.json / "
+                                      "events.jsonl / metrics.jsonl / "
+                                      "heartbeat_*.jsonl")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable summary instead of text")
+    p.add_argument("--stall-factor", type=float, default=5.0,
+                   help="heartbeat gap > factor x median interval = stall")
+    args = p.parse_args(argv)
+
+    if not os.path.isdir(args.result_dir):
+        print(f"not a directory: {args.result_dir}")
+        return 2
+    s = summarize(args.result_dir, stall_factor=args.stall_factor)
+    if not s["manifest"] and not s["attempts"] and not s["heartbeats"] \
+            and not s["metrics_records"]["total"]:
+        print(f"no telemetry files under {args.result_dir} "
+              f"(expected {MANIFEST_NAME} / events.jsonl / metrics.jsonl / "
+              "heartbeat_*.jsonl)")
+        return 2
+    try:
+        if args.json:
+            print(json.dumps(s, indent=1, default=float))
+        else:
+            print(format_report(s))
+    except BrokenPipeError:
+        return 0  # `report ... | head` is a legitimate way to read this
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,70 @@
+"""Wall-clock block timing (with MFU accounting) and jax.profiler traces."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import time
+
+
+class StepTimer:
+    """Wall-clock series over jitted blocks -> throughput summary."""
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._t0 = None
+        self.block_seconds = []
+
+    def start(self) -> None:
+        self._t0 = self._clock()
+
+    def stop(self) -> float:
+        if self._t0 is None:
+            raise RuntimeError("StepTimer.stop() before start()")
+        dt = self._clock() - self._t0
+        self._t0 = None
+        self.block_seconds.append(dt)
+        return dt
+
+    def summary(self, steps_per_block: int, batch: int,
+                flops_per_step: float = 0.0,
+                peak_flops: float = 0.0) -> dict:
+        """Throughput summary; pass `flops_per_step` (useful FLOPs of one
+        optimization step — e.g. 3 x forward-FLOPs x EOT x batch from
+        `jit(fwd).lower(...).compile().cost_analysis()["flops"]`) and the
+        chip's `peak_flops` to get a defensible `mfu` row (SURVEY.md §6).
+        This is the ONE MFU formula in the repo: `bench.py` and the offline
+        report CLI (`observe/report.py`) both route through it."""
+        total = float(sum(self.block_seconds))
+        n_steps = steps_per_block * len(self.block_seconds)
+        out = {
+            "blocks": len(self.block_seconds),
+            "total_seconds": round(total, 3),
+            "steps_per_sec": round(n_steps / total, 3) if total else 0.0,
+            "images_per_sec": round(n_steps * batch / total, 3) if total else 0.0,
+        }
+        if flops_per_step and peak_flops and total:
+            out["achieved_tflops"] = round(
+                n_steps * flops_per_step / total / 1e12, 2)
+            out["mfu"] = round(n_steps * flops_per_step / total / peak_flops, 4)
+        return out
+
+
+@contextlib.contextmanager
+def trace(trace_dir: Optional[str]):
+    """`jax.profiler` trace scope; no-op when `trace_dir` is falsy.
+
+    Produces a tensorboard-loadable trace of every XLA computation launched
+    in the scope — the rebuild's answer to the reference's absent profiling
+    (SURVEY.md §5)."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

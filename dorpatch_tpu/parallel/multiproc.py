@@ -39,6 +39,8 @@ import jax
 import numpy as np
 from jax.experimental import multihost_utils
 
+from dorpatch_tpu import observe
+
 
 def is_main() -> bool:
     """True on the process that owns artifact writes and logging."""
@@ -46,8 +48,26 @@ def is_main() -> bool:
 
 
 def _bcast(tree):
-    """Broadcast process 0's pytree of numpy arrays to all processes."""
-    return multihost_utils.broadcast_one_to_all(tree)
+    """Broadcast process 0's pytree of numpy arrays to all processes.
+
+    Telemetry: each broadcast is a span on the active EventLog — a
+    collective-mismatch hang wedges every process exactly here, and the
+    span's unclosed `begin` record (plus the heartbeat phase
+    `.../artifact_io/bcast`) is what makes that diagnosable post-mortem
+    (see `observe/heartbeat.py`)."""
+    with observe.span("bcast"):
+        return multihost_utils.broadcast_one_to_all(tree)
+
+
+def shared_run_id(run_id: str) -> str:
+    """Adopt process 0's per-attempt run_id on every process. One run must
+    carry ONE attempt id across all of its telemetry files (events_N,
+    heartbeat_N, run.json): the report CLI groups by run_id, so
+    independently drawn per-process ids would read as separate attempts and
+    drop proc>0 records from the attempt-filtered accounting."""
+    buf = np.frombuffer(run_id.encode("ascii")[:16].ljust(16, b" "),
+                        np.uint8).copy()
+    return bytes(_bcast(buf)).decode("ascii").strip()
 
 
 def _bcast_optional_arrays(values: Optional[Tuple[np.ndarray, ...]],
@@ -158,5 +178,6 @@ class Process0Store:
 
 __all__ = [
     "is_main",
+    "shared_run_id",
     "Process0Store",
 ]

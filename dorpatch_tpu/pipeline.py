@@ -10,8 +10,10 @@ The jax path is the product; per-batch flow:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
+import sys
 import time
 from typing import Dict, List
 
@@ -65,210 +67,295 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
     utils.set_global_seed(cfg.seed)       # host RNGs (`utils.py:16-21`)
     utils.select_device(cfg.device)       # `--device` flag (`utils.py:12-13`)
     utils.enable_compilation_cache()      # re-runs skip tunnel recompiles
+    proc = jax.process_index()
+    observe.set_process_index(proc)       # attributable multi-process logs
     is_main = (not multi) or parallel.multiproc.is_main()
     if verbose and is_main:
         # lets log consumers (chip_validation) tell a real accelerator run
         # from jax silently falling back to the CPU backend
-        print(f"backend: {jax.default_backend()} "
-              f"({len(jax.devices())} devices, "
-              f"{jax.process_count()} processes)", flush=True)
+        observe.log(f"backend: {jax.default_backend()} "
+                    f"({len(jax.devices())} devices, "
+                    f"{jax.process_count()} processes)")
     rng = np.random.default_rng(cfg.seed)
-    victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size,
-                       gn_impl=cfg.gn_impl)
     store = ArtifactStore(results_path(cfg))
     if multi:
         store = parallel.multiproc.Process0Store(store)
+
+    # Run telemetry (observe/): per-attempt run_id stamps every record so
+    # resumed runs are groupable; run.json makes the results dir
+    # self-describing; events.jsonl + heartbeat_<proc>.jsonl are per process
+    # but share ONE attempt id (process 0's, broadcast) so the report CLI
+    # groups the whole run as a single attempt.
+    run_id = observe.new_run_id()
+    if multi:
+        run_id = parallel.multiproc.shared_run_id(run_id)
     if is_main:
         write_config_record(cfg, store.result_dir)
+        observe.write_run_manifest(store.result_dir, cfg, run_id=run_id,
+                                   extra=observe.jax_environment())
+    elog = hb = watchdog = None
+    if cfg.metrics_log:
+        elog = observe.EventLog(
+            os.path.join(store.result_dir, observe.events_filename(proc)),
+            run_id=run_id, process_index=proc)
+        hb = observe.Heartbeat(
+            os.path.join(store.result_dir, observe.heartbeat_filename(proc)),
+            get_phase=elog.current_path, interval=cfg.heartbeat_interval,
+            process_index=proc, run_id=run_id)
+        if cfg.hang_timeout > 0:
+            watchdog = observe.Watchdog(store.result_dir, elog,
+                                        cfg.hang_timeout)
+    elif cfg.hang_timeout > 0:
+        # the watchdog's progress signal IS the event log — be loud rather
+        # than silently unprotected when telemetry is disabled
+        observe.log(f"WARNING: --hang-timeout {cfg.hang_timeout:g} ignored: "
+                    "telemetry is disabled (--no-metrics-log)",
+                    file=sys.stderr)
     logger = observe.AttackMetricsLogger(
         path=os.path.join(store.result_dir, "metrics.jsonl")
         if (cfg.metrics_log and is_main) else None,
         echo_every=cfg.attack.report_interval if (verbose and is_main) else 0,
+        run_id=run_id,
     )
-    mesh = None
-    if cfg.mesh_data * cfg.mesh_mask > 1:
-        mesh = parallel.make_mesh(cfg.mesh_data, cfg.mesh_mask)
-        defenses = parallel.make_sharded_defenses(
-            victim.apply, cfg.img_size, mesh, cfg.defense)
-        attack = parallel.make_sharded_attack(
-            victim.apply, victim.params, victim.num_classes, cfg.attack, mesh)
-    else:
-        defenses = build_defenses(victim.apply, cfg.img_size, cfg.defense)
-        attack = DorPatch(victim.apply, victim.params, victim.num_classes, cfg.attack)
-    attack.on_block_end = logger.on_block_end
 
-    preds_list: List[np.ndarray] = []
-    y_list: List[np.ndarray] = []
-    preds_adv_list: List[np.ndarray] = []
-    target_list: List[np.ndarray] = []
-    records: List[List] = []
+    def _on_block(stage, step, info):
+        logger.on_block_end(stage, step, info)
+        if elog is not None:  # block wall time + device-memory sample
+            elog.block_boundary(stage, step, info)
 
-    data_source = resolved_data_source(cfg)
-    batches = dataset_batches(
-        cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size, cfg.seed,
-        source=data_source,
-    )
-    timer = observe.StepTimer()
-    generated_images = 0
-    with observe.trace(cfg.trace_dir), logger:
-        for i, (x_np, y_np) in enumerate(batches):
+    with contextlib.ExitStack() as stack:
+        if elog is not None:
+            stack.enter_context(elog)
+            stack.enter_context(observe.active(elog))
+            stack.enter_context(hb)
+            if watchdog is not None:
+                stack.enter_context(watchdog)
+        stack.enter_context(observe.trace(cfg.trace_dir))
+        stack.enter_context(logger)
+        stack.enter_context(
+            observe.span("run", processes=int(jax.process_count())))
+
+        with observe.span("setup"):
+            victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir,
+                               cfg.img_size, gn_impl=cfg.gn_impl)
+            mesh = None
+            if cfg.mesh_data * cfg.mesh_mask > 1:
+                mesh = parallel.make_mesh(cfg.mesh_data, cfg.mesh_mask)
+                defenses = parallel.make_sharded_defenses(
+                    victim.apply, cfg.img_size, mesh, cfg.defense)
+                attack = parallel.make_sharded_attack(
+                    victim.apply, victim.params, victim.num_classes,
+                    cfg.attack, mesh)
+            else:
+                defenses = build_defenses(victim.apply, cfg.img_size,
+                                          cfg.defense)
+                attack = DorPatch(victim.apply, victim.params,
+                                  victim.num_classes, cfg.attack)
+            attack.on_block_end = _on_block
+
+        preds_list: List[np.ndarray] = []
+        y_list: List[np.ndarray] = []
+        preds_adv_list: List[np.ndarray] = []
+        target_list: List[np.ndarray] = []
+        records: List[List] = []
+
+        data_source = resolved_data_source(cfg)
+        batches = dataset_batches(
+            cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size, cfg.seed,
+            source=data_source,
+        )
+        timer = observe.StepTimer()
+        generated_images = 0
+        batch_iter = enumerate(batches)
+        while True:
+            # data fetch in its own span so the batch spans plus this one
+            # cover the whole loop's wall time (report coverage contract)
+            with observe.span("data"):
+                nxt = next(batch_iter, None)
+            if nxt is None:
+                break
+            i, (x_np, y_np) = nxt
             if i == cfg.num_batches:  # the reference's hard batch cap (`main.py:84`)
                 break
             t0 = time.time()
             logger.set_batch(i)
-            x = jnp.asarray(x_np)
+            with observe.span("batch", batch=i) as sp_batch:
+                x = jnp.asarray(x_np)
 
-            # keep only correctly-classified images (`main.py:91-99`)
-            preds = np.asarray(jnp.argmax(victim.apply(victim.params, x), -1))
-            if data_source == "synthetic":
-                # synthetic labels are random, so the correctness filter would
-                # be degenerate: score against the model's own clean
-                # predictions instead. Procedural labels are genuine — the
-                # filter keeps its reference semantics (`main.py:91-99`).
-                y_np = preds.copy()
-            correct = preds == y_np
-            if correct.sum() == 0:
-                continue
-            x = x[jnp.asarray(correct)]
-            y_np = y_np[correct]
-            preds = preds[correct]
-            if mesh is not None:
-                if multi:
-                    # per-image state replicates on multi-process meshes
-                    # (the masked batch still shards over the whole mesh;
-                    # see parallel/multiproc.py) — place_replicated handles
-                    # the multi-controller construction
-                    x = parallel.place_replicated(mesh, np.asarray(x))
+                # keep only correctly-classified images (`main.py:91-99`)
+                preds = np.asarray(
+                    jnp.argmax(victim.apply(victim.params, x), -1))
+                if data_source == "synthetic":
+                    # synthetic labels are random, so the correctness filter
+                    # would be degenerate: score against the model's own clean
+                    # predictions instead. Procedural labels are genuine — the
+                    # filter keeps its reference semantics (`main.py:91-99`).
+                    y_np = preds.copy()
+                correct = preds == y_np
+                sp_batch["images"] = int(correct.sum())
+                if correct.sum() == 0:
+                    continue
+                x = x[jnp.asarray(correct)]
+                y_np = y_np[correct]
+                preds = preds[correct]
+                if mesh is not None:
+                    if multi:
+                        # per-image state replicates on multi-process meshes
+                        # (the masked batch still shards over the whole mesh;
+                        # see parallel/multiproc.py) — place_replicated
+                        # handles the multi-controller construction
+                        x = parallel.place_replicated(mesh, np.asarray(x))
+                    else:
+                        # the correctness filter makes the surviving batch
+                        # size dynamic; shard it over the data axis when it
+                        # divides, else replicate (per-image state is tiny
+                        # next to the EOT activation batch)
+                        try:
+                            x = parallel.place_batch(mesh, x)
+                        except ValueError:
+                            x = jax.device_put(x, parallel.replicated(mesh))
+
+                with observe.span("artifact_io", op="load_patch"):
+                    cached = store.load_patch(i)
+                sp_batch["cached"] = cached is not None
+                if cached is not None:
+                    adv_mask, adv_pattern = map(jnp.asarray, cached)
+                    if cfg.attack.targeted:
+                        # recorded target (what the attack actually optimized)
+                        # first; reference re-derivation fallback — shared
+                        # contract in ArtifactStore.resolve_targets
+                        def _rederive(s0):
+                            delta0 = losses.l2_project(
+                                jnp.asarray(s0[0]), jnp.asarray(s0[1]), x,
+                                cfg.attack.eps)
+                            return jnp.argmax(
+                                victim.apply(victim.params, x + delta0), -1)
+
+                        with observe.span("artifact_io", op="resolve_targets"):
+                            target_list.append(
+                                store.resolve_targets(i, _rederive))
                 else:
-                    # the correctness filter makes the surviving batch size
-                    # dynamic; shard it over the data axis when it divides,
-                    # else replicate (per-image state is tiny next to the
-                    # EOT activation batch)
+                    if cfg.attack.targeted:
+                        y_attack = jnp.asarray(
+                            _random_targets(rng, y_np, victim.num_classes))
+                    else:
+                        y_attack = None
+                    ck = None
+                    if cfg.carry_checkpoints:
+                        from dorpatch_tpu.checkpoint import CarryCheckpointer
+
+                        ck = CarryCheckpointer(
+                            os.path.join(store.result_dir, f"carry_{i}"),
+                            fingerprint={
+                                "seed": int(cfg.seed),
+                                "batch": int(i),
+                                "n_images": int(x.shape[0]),
+                                "attack": repr(cfg.attack),
+                            })
+                        attack.checkpointer = ck
+                    timer.start()
                     try:
-                        x = parallel.place_batch(mesh, x)
-                    except ValueError:
-                        x = jax.device_put(x, parallel.replicated(mesh))
+                        with observe.span("attack"):
+                            result = attack.generate(
+                                x, y=y_attack, targeted=cfg.attack.targeted,
+                                key=jax.random.PRNGKey(cfg.seed + i),
+                                store=store, batch_id=i,
+                            )
+                            jax.block_until_ready(result.adv_pattern)
+                        if ck is not None:
+                            ck.clear()  # success: stale carries must not leak forward
+                    finally:
+                        attack.checkpointer = None
+                        if ck is not None:
+                            ck.close()  # on failure snapshots stay for resume
+                    timer.stop()
+                    generated_images += int(x.shape[0])
+                    if cfg.attack.targeted:
+                        # record the target the attack actually optimized
+                        # toward: on a carry-checkpoint resume the restored
+                        # state.y is the snapshot's target, not this process's
+                        # fresh rng draw — recording the draw would silently
+                        # corrupt certified-ASR. Persist it so cached re-runs
+                        # score the same target.
+                        target_list.append(np.asarray(result.y))
+                        with observe.span("artifact_io", op="save_targets"):
+                            store.save_targets(i, np.asarray(result.y))
+                    adv_mask, adv_pattern = result.adv_mask, result.adv_pattern
+                    with observe.span("artifact_io", op="save_patch"):
+                        store.save_patch(i, np.asarray(adv_mask),
+                                         np.asarray(adv_pattern))
 
-            cached = store.load_patch(i)
-            if cached is not None:
-                adv_mask, adv_pattern = map(jnp.asarray, cached)
-                if cfg.attack.targeted:
-                    # recorded target (what the attack actually optimized)
-                    # first; reference re-derivation fallback — shared
-                    # contract in ArtifactStore.resolve_targets
-                    def _rederive(s0):
-                        delta0 = losses.l2_project(
-                            jnp.asarray(s0[0]), jnp.asarray(s0[1]), x,
-                            cfg.attack.eps)
-                        return jnp.argmax(
-                            victim.apply(victim.params, x + delta0), -1)
+                delta = losses.l2_project(adv_mask, adv_pattern, x,
+                                          cfg.attack.eps)
+                adv_x = x + delta
 
-                    target_list.append(store.resolve_targets(i, _rederive))
-            else:
-                if cfg.attack.targeted:
-                    y_attack = jnp.asarray(
-                        _random_targets(rng, y_np, victim.num_classes))
-                else:
-                    y_attack = None
-                ck = None
-                if cfg.carry_checkpoints:
-                    from dorpatch_tpu.checkpoint import CarryCheckpointer
+                # PatchCleanser evaluation with record cache
+                # (`main.py:144-153`); a cache from a different defense bank
+                # (wrong per-image record count) is recomputed rather than
+                # silently reused
+                with observe.span("artifact_io", op="load_pc_records"):
+                    recs = store.load_pc_records(i)
+                if recs is not None and any(
+                        len(r) != len(defenses) for r in recs):
+                    recs = None
+                if recs is None:
+                    with observe.span("certify", batch=i,
+                                      images=int(x.shape[0])):
+                        per_defense = [
+                            d.robust_predict(victim.params, adv_x,
+                                             victim.num_classes)
+                            for d in defenses
+                        ]
+                    # records_batch[img][defense], the reference's nesting
+                    recs = [list(r) for r in zip(*per_defense)]
+                    with observe.span("artifact_io", op="save_pc_records"):
+                        store.save_pc_records(i, recs)
 
-                    ck = CarryCheckpointer(
-                        os.path.join(store.result_dir, f"carry_{i}"),
-                        fingerprint={
-                            "seed": int(cfg.seed),
-                            "batch": int(i),
-                            "n_images": int(x.shape[0]),
-                            "attack": repr(cfg.attack),
-                        })
-                    attack.checkpointer = ck
-                timer.start()
-                try:
-                    result = attack.generate(
-                        x, y=y_attack, targeted=cfg.attack.targeted,
-                        key=jax.random.PRNGKey(cfg.seed + i), store=store,
-                        batch_id=i,
-                    )
-                    jax.block_until_ready(result.adv_pattern)
-                    if ck is not None:
-                        ck.clear()  # success: stale carries must not leak forward
-                finally:
-                    attack.checkpointer = None
-                    if ck is not None:
-                        ck.close()  # on failure snapshots stay for resume
-                timer.stop()
-                generated_images += int(x.shape[0])
-                if cfg.attack.targeted:
-                    # record the target the attack actually optimized toward:
-                    # on a carry-checkpoint resume the restored state.y is the
-                    # snapshot's target, not this process's fresh rng draw —
-                    # recording the draw would silently corrupt certified-ASR.
-                    # Persist it so cached re-runs score the same target.
-                    target_list.append(np.asarray(result.y))
-                    store.save_targets(i, np.asarray(result.y))
-                adv_mask, adv_pattern = result.adv_mask, result.adv_pattern
-                store.save_patch(i, np.asarray(adv_mask), np.asarray(adv_pattern))
+                preds_list.append(preds)
+                y_list.append(y_np)
+                preds_adv_list.append(np.asarray(
+                    jnp.argmax(victim.apply(victim.params, adv_x), -1)))
+                records.extend(recs)
+                if verbose and is_main:
+                    observe.log(f"batch {i}: {len(y_np)} imgs in "
+                                f"{time.time() - t0:.1f}s")
 
-            delta = losses.l2_project(adv_mask, adv_pattern, x, cfg.attack.eps)
-            adv_x = x + delta
+        with observe.span("finalize"):
+            if not preds_list:
+                empty = {"clean_accuracy": 0.0, "robust_accuracy": 0.0,
+                         "acc_pc": [], "certified_acc_pc": [],
+                         "certified_asr_pc": [], "evaluated_images": 0,
+                         "report": "no correctly-classified images evaluated"}
+                if verbose and is_main:
+                    observe.log(empty["report"])
+                return empty
+            preds_clean = np.concatenate(preds_list)
+            y_all = np.concatenate(y_list)
+            preds_adv = np.concatenate(preds_adv_list)
+            targets = np.concatenate(target_list) if target_list else None
 
-            # PatchCleanser evaluation with record cache (`main.py:144-153`);
-            # a cache from a different defense bank (wrong per-image record
-            # count) is recomputed rather than silently reused
-            recs = store.load_pc_records(i)
-            if recs is not None and any(len(r) != len(defenses) for r in recs):
-                recs = None
-            if recs is None:
-                per_defense = [
-                    d.robust_predict(victim.params, adv_x, victim.num_classes)
-                    for d in defenses
-                ]
-                # records_batch[img][defense], the reference's nesting
-                recs = [list(r) for r in zip(*per_defense)]
-                store.save_pc_records(i, recs)
-
-            preds_list.append(preds)
-            y_list.append(y_np)
-            preds_adv_list.append(
-                np.asarray(jnp.argmax(victim.apply(victim.params, adv_x), -1)))
-            records.extend(recs)
+            for di, d in enumerate(defenses):
+                d.collect([r[di] for r in records])
+            m = metrics.compute_metrics(
+                preds_clean, y_all, preds_adv, [d.result for d in defenses],
+                targets)
+            m["evaluated_images"] = int(len(y_all))
+            if targets is not None:
+                m["targets"] = [int(t) for t in targets]
+            if timer.block_seconds:
+                # per-generate wall clock (each "block" is one
+                # attack.generate call)
+                m["attack_seconds"] = timer.block_seconds
+                m["attack_images_per_sec"] = round(
+                    generated_images / sum(timer.block_seconds), 4)
+            m["report"] = metrics.report_line(m)
             if verbose and is_main:
-                print(f"batch {i}: {len(y_np)} imgs in {time.time() - t0:.1f}s",
-                      flush=True)
-
-    if not preds_list:
-        empty = {"clean_accuracy": 0.0, "robust_accuracy": 0.0,
-                 "acc_pc": [], "certified_acc_pc": [], "certified_asr_pc": [],
-                 "evaluated_images": 0,
-                 "report": "no correctly-classified images evaluated"}
-        if verbose and is_main:
-            print(empty["report"])
-        return empty
-    preds_clean = np.concatenate(preds_list)
-    y_all = np.concatenate(y_list)
-    preds_adv = np.concatenate(preds_adv_list)
-    targets = np.concatenate(target_list) if target_list else None
-
-    for di, d in enumerate(defenses):
-        d.collect([r[di] for r in records])
-    m = metrics.compute_metrics(
-        preds_clean, y_all, preds_adv, [d.result for d in defenses], targets)
-    m["evaluated_images"] = int(len(y_all))
-    if targets is not None:
-        m["targets"] = [int(t) for t in targets]
-    if timer.block_seconds:
-        # per-generate wall clock (each "block" is one attack.generate call)
-        m["attack_seconds"] = timer.block_seconds
-        m["attack_images_per_sec"] = round(
-            generated_images / sum(timer.block_seconds), 4)
-    m["report"] = metrics.report_line(m)
-    if verbose and is_main:
-        print(m["report"])
-    if is_main:
-        try:
-            with open(os.path.join(store.result_dir, "summary.json"), "w") as fh:
-                json.dump(m, fh, indent=1, default=float)
-        except OSError:
-            pass  # read-only results dir: the return value carries everything
-    return m
+                observe.log(m["report"])
+            if is_main:
+                try:
+                    with open(os.path.join(store.result_dir,
+                                           "summary.json"), "w") as fh:
+                        json.dump(m, fh, indent=1, default=float)
+                except OSError:
+                    pass  # read-only results dir: the return value carries everything
+            return m

@@ -89,14 +89,18 @@ def run_sweep(
         timer.start()
         # same key for every grid point (the reference protocol: one process
         # per point, same --seed) so row deltas isolate the hyperparameters
-        res = attack.generate(x, key=jax.random.PRNGKey(cfg.seed))
-        jax.block_until_ready(res.adv_pattern)
+        with observe.span("sweep.point", point=gi, patch_budget=budget,
+                          density=density, structured=structured):
+            res = attack.generate(x, key=jax.random.PRNGKey(cfg.seed))
+            jax.block_until_ready(res.adv_pattern)
         seconds = timer.stop()
 
         delta = losses.l2_project(res.adv_mask, res.adv_pattern, x, acfg.eps)
         adv_x = x + delta
         preds_adv = np.asarray(jnp.argmax(victim.apply(victim.params, adv_x), -1))
-        recs = defense.robust_predict(victim.params, adv_x, victim.num_classes)
+        with observe.span("certify", point=gi, images=int(x.shape[0])):
+            recs = defense.robust_predict(
+                victim.params, adv_x, victim.num_classes)
         defense.collect(recs)  # one metric definition (metrics.compute_metrics)
         m = metrics.compute_metrics(
             np.asarray(y_np), y_np, preds_adv, [defense.result])
@@ -113,12 +117,12 @@ def run_sweep(
         }
         rows.append(row)
         if verbose:
-            print(json.dumps(row), flush=True)
+            observe.log(json.dumps(row))
     if verbose and proto is not None:
-        print(json.dumps({
+        observe.log(json.dumps({
             "block_programs": len(proto._programs),
             "grid_points": len(grid),
-        }), flush=True)
+        }))
     return rows
 
 
@@ -160,8 +164,8 @@ def main(argv: Optional[Sequence[str]] = None):
     t0 = time.time()
     rows = run_sweep(cfg, args.patch_budgets, args.densities, args.structureds,
                      args.defense_ratio)
-    print(json.dumps({"sweep_points": len(rows),
-                      "total_seconds": round(time.time() - t0, 1)}))
+    observe.log(json.dumps({"sweep_points": len(rows),
+                            "total_seconds": round(time.time() - t0, 1)}))
     return rows
 
 

@@ -25,13 +25,16 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import os
 import sys
 import time
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
+
+from dorpatch_tpu import observe
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,12 +92,43 @@ def _trainable_arch(arch: str) -> str:
     return name
 
 
-def train_victim(cfg: TrainConfig = TrainConfig(), log=print) -> Tuple[dict, dict]:
+def train_victim(cfg: TrainConfig = TrainConfig(), log=observe.log,
+                 telemetry_dir: Optional[str] = None) -> Tuple[dict, dict]:
     """Train the cfg.arch victim (cifar_resnet18 or cifar_vit) on the
     procedural task; returns (params, report).
 
     report: {"test_acc", "train_acc", "steps", "seconds", "backend"}.
+    `telemetry_dir` (optional) gets the same run-telemetry contract as an
+    experiment results dir — run.json, events.jsonl spans per epoch, a
+    heartbeat file — readable by `python -m dorpatch_tpu.observe.report`.
     """
+    arch_name = _trainable_arch(cfg.arch)  # fail fast, before any telemetry
+
+    telemetry = contextlib.ExitStack()
+    if telemetry_dir:
+        run_id = observe.new_run_id()
+        observe.write_run_manifest(
+            telemetry_dir, cfg, run_id=run_id,
+            extra=observe.jax_environment())
+        elog = telemetry.enter_context(observe.EventLog(
+            os.path.join(telemetry_dir, observe.events_filename()),
+            run_id=run_id))
+        telemetry.enter_context(observe.active(elog))
+        telemetry.enter_context(observe.Heartbeat(
+            os.path.join(telemetry_dir, observe.heartbeat_filename()),
+            get_phase=elog.current_path, run_id=run_id))
+        telemetry.enter_context(observe.span("run"))
+    # `with` (not happy-path close): exceptions must unwind the heartbeat
+    # daemon and the process-global active EventLog, or a later run in the
+    # same process writes spans into this stale telemetry dir. A genuine
+    # hang never unwinds anyway, so the unclosed-span post-mortem signature
+    # (observe/events.py docstring) is preserved.
+    with telemetry:
+        return _train_victim_impl(cfg, arch_name, log)
+
+
+def _train_victim_impl(cfg: TrainConfig, arch_name: str,
+                       log) -> Tuple[dict, dict]:
     import jax
     import jax.numpy as jnp
     import optax
@@ -104,7 +138,6 @@ def train_victim(cfg: TrainConfig = TrainConfig(), log=print) -> Tuple[dict, dic
 
     from dorpatch_tpu.models import registry
 
-    arch_name = _trainable_arch(cfg.arch)  # fail fast, before the data load
     utils.enable_compilation_cache()
 
     tr_x, tr_y = data_lib.training_arrays(
@@ -178,19 +211,23 @@ def train_victim(cfg: TrainConfig = TrainConfig(), log=print) -> Tuple[dict, dic
     step = 0
     train_acc = 0.0
     for epoch in range(cfg.epochs):
-        order = rng.permutation(len(tr_x))
-        accs = []
-        for i in range(steps_per_epoch):
-            sel = jnp.asarray(order[i * cfg.batch_size:(i + 1) * cfg.batch_size])
-            key, sub = jax.random.split(key)
-            params, opt_state, loss, acc = train_step(
-                params, opt_state, sub, dev_tr_x[sel], dev_tr_y[sel])
-            accs.append(acc)
-            step += 1
-        train_acc = float(jnp.mean(jnp.stack(accs)))
+        with observe.span("train.epoch", epoch=epoch + 1) as sp:
+            order = rng.permutation(len(tr_x))
+            accs = []
+            for i in range(steps_per_epoch):
+                sel = jnp.asarray(
+                    order[i * cfg.batch_size:(i + 1) * cfg.batch_size])
+                key, sub = jax.random.split(key)
+                params, opt_state, loss, acc = train_step(
+                    params, opt_state, sub, dev_tr_x[sel], dev_tr_y[sel])
+                accs.append(acc)
+                step += 1
+            train_acc = float(jnp.mean(jnp.stack(accs)))
+            sp["train_acc"] = round(train_acc, 4)
         log(f"epoch {epoch + 1}/{cfg.epochs}: train_acc={train_acc:.3f} "
             f"({time.perf_counter() - t0:.0f}s)")
-    acc = test_acc(params)
+    with observe.span("train.eval"):
+        acc = test_acc(params)
     report = {
         "test_acc": acc,
         "train_acc": train_acc,
@@ -241,15 +278,19 @@ def main(argv=None) -> int:
                    choices=("procedural", "disk"),
                    help="disk = real CIFAR train batches under --data-dir")
     p.add_argument("--data-dir", default="data/")
+    p.add_argument("--telemetry-dir", default="",
+                   help="write run telemetry (run.json, events.jsonl, "
+                        "heartbeat) here; readable by "
+                        "`python -m dorpatch_tpu.observe.report`")
     args = p.parse_args(argv)
 
     cfg = TrainConfig(dataset=args.dataset, arch=args.arch, epochs=args.epochs,
                       batch_size=args.batch_size, lr=args.lr, seed=args.seed,
                       n_per_class_train=args.n_per_class,
                       data_source=args.data_source, data_dir=args.data_dir)
-    params, report = train_victim(cfg)
+    params, report = train_victim(cfg, telemetry_dir=args.telemetry_dir)
     path = save_victim_checkpoint(params, args.out, args.dataset, args.arch)
-    print(f"saved {path}; report={report}")
+    observe.log(f"saved {path}; report={report}")
     return 0
 
 

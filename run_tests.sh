@@ -4,4 +4,12 @@
 # behind any other live JAX process); tests run on an 8-device virtual CPU
 # mesh regardless (tests/conftest.py).
 cd "$(dirname "$0")"
-exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@" \
+  || exit $?
+# Smoke: the offline telemetry report CLI must render the checked-in fixture
+# results dir end-to-end (tests/test_report.py covers the content; this
+# covers the `python -m` entry point itself).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python -m dorpatch_tpu.observe.report tests/fixtures/report_run \
+  > /dev/null || exit $?
+echo "report CLI smoke: OK"

@@ -127,6 +127,12 @@ def test_parsers_reject_silent_cpu_fallback():
     assert cv.parse_train({"rc": 0, "stdout": train_ok}) is not None
     train_cpu = train_ok.replace("'axon'", "'cpu'")
     assert cv.parse_train({"rc": 0, "stdout": train_cpu}) is None
+    # train.py now routes output through observe.log, which prefixes
+    # `[pN +T.Ts]` — the parser must still find the saved-line marker
+    train_prefixed = ("[p0 +300.1s] epoch 12/12: train_acc=0.99 (300s)\n"
+                      "[p0 +301.0s] saved /x/v.pth; report={'test_acc': "
+                      "0.97, 'backend': 'axon'}\n")
+    assert cv.parse_train({"rc": 0, "stdout": train_prefixed}) is not None
 
     flag_ok = ("backend: axon (1 devices)\n"
                "clean accuracy: 97.00%, ... certified_ASR@PC:0.00%\n")

@@ -1,4 +1,5 @@
-"""Observability subsystem: metrics JSONL sink, step timer, trace no-op."""
+"""Observability subsystem: metrics JSONL sink, step timer, trace no-op,
+span/event log, heartbeats + watchdog, run manifest."""
 
 import json
 
@@ -85,3 +86,218 @@ def test_step_timer_summary_reports_mfu():
     # without flops/peak the mfu keys are absent (no bogus utilization rows)
     s2 = t.summary(steps_per_block=5, batch=2)
     assert "mfu" not in s2 and "achieved_tflops" not in s2
+
+
+# ---------------- run_id stamping (resume disambiguation) ----------------
+
+
+def test_metrics_logger_stamps_run_id(tmp_path):
+    """Append-mode resume fix: every record carries the attempt's run_id so
+    interleaved duplicate (batch, stage, step) records are groupable."""
+    path = str(tmp_path / "metrics.jsonl")
+    with observe.AttackMetricsLogger(path, run_id="attempt1") as a:
+        a.on_block_end(0, 5, _info(range(8)))
+    with observe.AttackMetricsLogger(path, run_id="attempt2") as b:
+        b.on_block_end(0, 5, _info(range(8)))
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["run_id"] for l in lines] == ["attempt1", "attempt2"]
+    assert lines[0]["step"] == lines[1]["step"] == 5  # same key, two attempts
+    # no run_id given -> no key (unstamped legacy shape preserved)
+    with observe.AttackMetricsLogger(path) as c:
+        c.on_block_end(0, 5, _info(range(8)))
+    assert "run_id" not in json.loads(open(path).read().splitlines()[-1])
+
+
+# ---------------- span/event log ----------------
+
+
+def test_event_log_span_nesting_and_ordering(tmp_path):
+    """Spans nest (path/depth), seq strictly increases, durations come from
+    the injected perf clock, and late-added attrs land on the close record."""
+    path = str(tmp_path / "events.jsonl")
+    perf = iter([float(i) for i in range(100)]).__next__
+    clock = iter([1000.0 + i for i in range(100)]).__next__
+    elog = observe.EventLog(path, run_id="r1", process_index=3,
+                            clock=clock, perf=perf)
+    with elog:
+        with elog.span("run"):
+            with elog.span("batch", batch=0) as sp:
+                sp["images"] = 2
+            elog.event("note", detail="x")
+    recs = [json.loads(l) for l in open(path)]
+    assert [r["seq"] for r in recs] == list(range(len(recs)))
+    assert all(r["proc"] == 3 and r["run_id"] == "r1" for r in recs)
+    kinds = [(r["kind"], r.get("name")) for r in recs]
+    assert kinds == [("begin", "run"), ("begin", "batch"),
+                     ("span", "batch"), ("event", "note"), ("span", "run")]
+    batch = recs[2]
+    assert batch["path"] == "run/batch" and batch["depth"] == 1
+    assert batch["images"] == 2 and batch["batch"] == 0
+    assert batch["dur_s"] > 0
+    run = recs[4]
+    assert run["path"] == "run" and run["depth"] == 0
+    assert run["dur_s"] > batch["dur_s"]  # outer span encloses inner
+
+
+def test_event_log_current_path_and_activity(tmp_path):
+    ticks = iter([float(i) for i in range(100)]).__next__
+    elog = observe.EventLog(str(tmp_path / "e.jsonl"), perf=ticks)
+    assert elog.current_path() == "idle"
+    with elog.span("run"):
+        with elog.span("certify"):
+            assert elog.current_path() == "run/certify"
+        assert elog.current_path() == "run"
+    assert elog.current_path() == "idle"
+    assert elog.seconds_since_activity() >= 0.0
+
+
+def test_module_span_noops_without_active_log(tmp_path):
+    """The attack/defense layers call observe.span unconditionally; with no
+    active EventLog it must be a free no-op that still yields an attrs dict."""
+    with observe.span("anything", x=1) as sp:
+        sp["y"] = 2  # must not raise
+    assert observe.active_event_log() is None
+    elog = observe.EventLog(str(tmp_path / "e.jsonl"), run_id="r")
+    with elog, observe.active(elog):
+        assert observe.active_event_log() is elog
+        with observe.span("inner"):
+            pass
+        observe.record_event("evt", k=1)
+        observe.record_compile("prog", 1.5)
+    assert observe.active_event_log() is None
+    kinds = [json.loads(l)["kind"] for l in open(str(tmp_path / "e.jsonl"))]
+    assert kinds == ["begin", "span", "event", "compile"]
+
+
+def test_timed_first_call_records_compile_once(tmp_path):
+    calls = []
+    clock = iter([10.0, 12.5, 20.0, 20.1]).__next__
+    fn = observe.timed_first_call(lambda v: calls.append(v) or v,
+                                  "prog.x", clock=clock)
+    elog = observe.EventLog(str(tmp_path / "e.jsonl"))
+    with elog, observe.active(elog):
+        assert fn(1) == 1 and fn(2) == 2
+    recs = [json.loads(l) for l in open(str(tmp_path / "e.jsonl"))]
+    compiles = [r for r in recs if r["kind"] == "compile"]
+    assert len(compiles) == 1  # only the first call is timed
+    assert compiles[0]["name"] == "prog.x"
+    assert compiles[0]["dur_s"] == 2.5
+    assert calls == [1, 2]  # pass-through untouched
+
+
+def test_event_log_block_boundary_and_unwritable_dir(tmp_path):
+    elog = observe.EventLog(str(tmp_path / "e.jsonl"))
+    elog.block_boundary(1, 100, {"stopped": True})
+    rec = json.loads(open(str(tmp_path / "e.jsonl")).read())
+    assert rec["kind"] == "block" and rec["stage"] == 1 and rec["step"] == 100
+    assert rec["stopped"] is True and rec["dur_s"] >= 0
+    # memory sampling never raises on hosts without allocator stats
+    assert observe.device_memory_stats() is None or isinstance(
+        observe.device_memory_stats(), list)
+    # unwritable path degrades to a tracking-only sink, not an exception
+    ro = tmp_path / "ro"
+    ro.mkdir()
+    ro.chmod(0o500)
+    try:
+        silent = observe.EventLog(str(ro / "sub" / "e.jsonl"))
+        with silent.span("run"):
+            assert silent.current_path() == "run"
+    finally:
+        ro.chmod(0o700)
+
+
+# ---------------- heartbeats + watchdog ----------------
+
+
+def test_heartbeat_writes_beats_and_read_back(tmp_path):
+    import time as _time
+
+    path = str(tmp_path / "heartbeat_0.jsonl")
+    phases = iter(["run/setup"] + ["run/batch"] * 50).__next__
+    with observe.Heartbeat(path, get_phase=phases, interval=0.01,
+                           process_index=0, run_id="r1"):
+        _time.sleep(0.08)
+    beats = observe.read_heartbeats(str(tmp_path))["heartbeat_0.jsonl"]
+    assert len(beats) >= 3  # immediate first beat + periodic + exit beat
+    assert [b["seq"] for b in beats] == list(range(len(beats)))
+    assert beats[0]["phase"] == "run/setup"
+    assert beats[-1]["phase"] == "exit"  # clean shutdown marker
+    assert all(b["run_id"] == "r1" and b["proc"] == 0 for b in beats)
+
+
+def test_heartbeat_gap_stall_detection(tmp_path):
+    """The report's stall rule: a gap far beyond the median beat interval
+    within ONE attempt flags a stall; resume boundaries don't."""
+    beats = ([{"ts": 10.0 + i, "seq": i, "phase": "a", "run_id": "r1"}
+              for i in range(5)]
+             + [{"ts": 25.0, "seq": 5, "phase": "b", "run_id": "r1"}]  # 11s gap
+             + [{"ts": 100.0, "seq": 0, "phase": "c", "run_id": "r2"}])
+    with open(tmp_path / "heartbeat_0.jsonl", "w") as fh:
+        for b in beats:
+            fh.write(json.dumps(b) + "\n")
+    gaps = observe.heartbeat_gaps(beats)
+    assert max(gaps) == 11.0
+    assert len(gaps) == 5  # the r1->r2 resume boundary is not a gap
+    rows = observe.summarize_heartbeats(str(tmp_path))
+    assert rows[0]["stalled"] is True and rows[0]["max_gap_s"] == 11.0
+    # steady beats are not stalled
+    with open(tmp_path / "heartbeat_1.jsonl", "w") as fh:
+        for i in range(6):
+            fh.write(json.dumps({"ts": 10.0 + i, "seq": i, "phase": "a",
+                                 "run_id": "r1"}) + "\n")
+    rows = observe.summarize_heartbeats(str(tmp_path))
+    assert [r["stalled"] for r in rows] == [True, False]
+
+
+def test_watchdog_fires_on_stalled_event_log(tmp_path):
+    """--hang-timeout semantics: no EventLog progress past the timeout ->
+    print every process's last-known phase and abort."""
+    perf_now = [0.0]
+    elog = observe.EventLog(str(tmp_path / "events.jsonl"),
+                            perf=lambda: perf_now[0])
+    with open(tmp_path / "heartbeat_1.jsonl", "w") as fh:
+        fh.write(json.dumps({"ts": 5.0, "seq": 7, "proc": 1,
+                             "phase": "run/batch/artifact_io/bcast"}) + "\n")
+    aborted = []
+    echoed = []
+    dog = observe.Watchdog(
+        str(tmp_path), elog, timeout_s=10.0,
+        on_abort=lambda: aborted.append(True),
+        echo=lambda msg, **kw: echoed.append(msg), clock=lambda: 30.0)
+    elog.event("progress")          # activity at perf 0
+    perf_now[0] = 5.0
+    assert dog.check() is False     # under the timeout: no fire
+    perf_now[0] = 11.0
+    assert dog.check() is True      # 11s idle > 10s timeout
+    assert aborted == [True]
+    joined = "\n".join(echoed)
+    assert "WATCHDOG" in joined
+    assert "run/batch/artifact_io/bcast" in joined  # last-known phase shown
+
+
+# ---------------- run manifest ----------------
+
+
+def test_run_manifest_contents_and_attempt_chain(tmp_path):
+    cfg = __import__("dorpatch_tpu.config", fromlist=["x"]).ExperimentConfig()
+    p = observe.write_run_manifest(str(tmp_path), cfg, run_id="aaa")
+    m = json.load(open(p))
+    assert m["run_id"] == "aaa"
+    assert m["config"]["dataset"] == "imagenet"
+    assert m["hostname"] and m["python"] and m["pid"]
+    assert "previous_run_ids" not in m
+    # a resumed attempt chains the prior id
+    observe.write_run_manifest(str(tmp_path), cfg, run_id="bbb",
+                               extra={"backend": "cpu"})
+    m2 = json.load(open(str(tmp_path / "run.json")))
+    assert m2["run_id"] == "bbb" and m2["previous_run_ids"] == ["aaa"]
+    assert m2["backend"] == "cpu"
+    assert observe.new_run_id() != observe.new_run_id()
+
+
+def test_shared_run_id_single_process():
+    """Multi-process runs adopt process 0's attempt id (broadcast); in a
+    single-process world the broadcast is the identity."""
+    from dorpatch_tpu.parallel import multiproc
+
+    assert multiproc.shared_run_id("abc123def456") == "abc123def456"

@@ -1,0 +1,59 @@
+"""Lint guard: no bare `print(` calls in `dorpatch_tpu/` outside `observe/`.
+
+Multi-process output must stay attributable — anonymous prints from N SPMD
+processes interleave uselessly. Everything routes through `observe.log()`
+(process-index + elapsed-time prefix); `observe/` itself implements that
+sink and the report CLI's stdout, so it is the one allowed exception.
+
+Token-based (not regex) so comments/docstrings mentioning print( and
+`log=print`-style references don't false-positive: only a NAME token
+`print` immediately followed by `(` and not preceded by `.` counts.
+"""
+
+import io
+import pathlib
+import tokenize
+
+PKG = pathlib.Path(__file__).resolve().parents[1] / "dorpatch_tpu"
+
+
+def bare_print_calls(path: pathlib.Path):
+    toks = list(tokenize.tokenize(io.BytesIO(path.read_bytes()).readline))
+    lines = []
+    for i, t in enumerate(toks):
+        if t.type != tokenize.NAME or t.string != "print":
+            continue
+        nxt = toks[i + 1] if i + 1 < len(toks) else None
+        prev = toks[i - 1] if i > 0 else None
+        if nxt is not None and nxt.type == tokenize.OP and nxt.string == "(" \
+                and not (prev is not None and prev.type == tokenize.OP
+                         and prev.string == "."):
+            lines.append(t.start[0])
+    return lines
+
+
+def test_no_bare_print_outside_observe():
+    offenders = {}
+    for path in sorted(PKG.rglob("*.py")):
+        if "observe" in path.relative_to(PKG).parts:
+            continue
+        lines = bare_print_calls(path)
+        if lines:
+            offenders[str(path.relative_to(PKG))] = lines
+    assert not offenders, (
+        "bare print( calls found — route them through observe.log() so "
+        f"multi-process output stays attributable: {offenders}")
+
+
+def test_guard_detects_prints(tmp_path):
+    """The guard itself must actually catch a bare print (and only that)."""
+    p = tmp_path / "x.py"
+    p.write_text(
+        "# print( in a comment is fine\n"
+        's = "print(also fine)"\n'
+        "log = print  # referencing the callable is fine\n"
+        "import sys\n"
+        "sys.stdout.write('x')\n"
+        "print('caught')\n"
+    )
+    assert bare_print_calls(p) == [6]

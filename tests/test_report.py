@@ -1,0 +1,148 @@
+"""Offline report CLI (`python -m dorpatch_tpu.observe.report`) against the
+checked-in fixture results dir, plus the tier-1 acceptance run: a tiny
+single-process CPU experiment must leave the full telemetry contract behind
+(run.json, events.jsonl with >=95%-coverage nested spans, heartbeats) and
+the report must render it without error."""
+
+import json
+import os
+
+import pytest
+
+from dorpatch_tpu.observe import report
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "report_run")
+
+
+def test_report_cli_renders_fixture(capsys):
+    assert report.main([FIXTURE]) == 0
+    out = capsys.readouterr().out
+    # per-phase breakdown + coverage
+    assert "phase breakdown" in out and "span coverage" in out
+    assert "batch" in out and "setup" in out
+    # compile-vs-run accounting
+    assert "compile time:" in out
+    assert "attack.block.stage0.steps5" in out
+    # throughput incl. MFU through the shared StepTimer.summary path
+    assert "steps/sec" in out and "images/sec" in out
+    assert "mfu: 0.0327" in out
+    # heartbeat stall detection: proc 1's 6s gap inside a bcast is flagged
+    assert "** STALL **" in out
+    assert "run/batch/artifact_io/bcast" in out
+    # resumed-run grouping by run_id
+    assert "2 attempt(s)" in out
+    assert "beef00000001" in out and "beef00000002" in out
+
+
+def test_report_json_mode_summary(capsys):
+    assert report.main([FIXTURE, "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["run_id"] == "beef00000002"
+    assert s["attempts"] == ["beef00000001", "beef00000002"]
+    assert s["run_seconds"] == 20.3
+    assert s["coverage"] >= 0.95
+    phases = {p["name"]: p for p in s["phases"]}
+    assert phases["batch"]["count"] == 2 and phases["batch"]["pct"] > 50
+    assert s["compile"]["total_s"] == pytest.approx(4.4)
+    assert s["attack"]["steps"] == 40  # latest attempt only (run_id-grouped)
+    assert s["attack"]["images_generated"] == 4
+    assert s["certify"]["images_per_sec"] > 0
+    assert s["mfu"]["mfu"] == pytest.approx(0.0327, abs=1e-4)
+    assert s["peak_device_bytes"] == 3_900_000_000
+    stalls = {h["file"]: h["stalled"] for h in s["heartbeats"]}
+    assert stalls == {"heartbeat_0.jsonl": False, "heartbeat_1.jsonl": True}
+    assert s["metrics_records"]["by_attempt"] == {"beef00000001": 8,
+                                                  "beef00000002": 8}
+
+
+def test_report_rejects_missing_or_empty_dir(tmp_path, capsys):
+    assert report.main([str(tmp_path / "nope")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert report.main([str(empty)]) == 2
+    assert "no telemetry" in capsys.readouterr().out
+
+
+def test_report_flags_open_spans_as_hang(tmp_path, capsys):
+    """A run that died mid-collective leaves begin records with no close —
+    the report must surface them instead of pretending the run finished."""
+    with open(tmp_path / "events.jsonl", "w") as fh:
+        for i, (kind, name, path, depth) in enumerate([
+                ("begin", "run", "run", 0),
+                ("begin", "batch", "run/batch", 1),
+                ("begin", "artifact_io", "run/batch/artifact_io", 2)]):
+            fh.write(json.dumps({"ts": 100.0 + i, "seq": i, "proc": 0,
+                                 "run_id": "r1", "kind": kind, "name": name,
+                                 "path": path, "depth": depth}) + "\n")
+    assert report.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "run span never closed" in out
+    assert "OPEN" in out and "run/batch/artifact_io" in out
+
+
+def test_telemetry_e2e_single_process_cpu(tmp_path, capsys):
+    """ISSUE acceptance: a single-process CPU run (synthetic data, small
+    victim) produces run.json, events.jsonl with nested spans covering >=95%
+    of wall time, and heartbeat files; the report CLI renders the directory
+    without error."""
+    from dorpatch_tpu.artifacts import results_path
+    from dorpatch_tpu.config import (AttackConfig, DefenseConfig,
+                                     ExperimentConfig)
+    from dorpatch_tpu.pipeline import run_experiment
+
+    cfg = ExperimentConfig(
+        dataset="cifar10",
+        base_arch="resnet18",
+        batch_size=2,
+        num_batches=1,
+        synthetic_data=True,
+        img_size=32,
+        results_root=str(tmp_path / "results"),
+        heartbeat_interval=0.1,
+        attack=AttackConfig(
+            sampling_size=4, max_iterations=4, sweep_interval=2,
+            switch_iteration=2, dropout=1, basic_unit=4, patch_budget=0.15,
+        ),
+        defense=DefenseConfig(ratios=(0.06,), chunk_size=18),
+    )
+    m = run_experiment(cfg, verbose=False)
+    rd = results_path(cfg)
+
+    # run manifest: self-describing results dir
+    manifest = json.load(open(os.path.join(rd, "run.json")))
+    assert manifest["run_id"] and manifest["backend"] == "cpu"
+    assert manifest["config"]["attack"]["max_iterations"] == 4
+    assert manifest["process_count"] == 1
+
+    # events: nested spans with the documented names, >=95% coverage
+    events = [json.loads(l) for l in open(os.path.join(rd, "events.jsonl"))]
+    assert [e["seq"] for e in events] == list(range(len(events)))
+    spans = [e for e in events if e["kind"] == "span"]
+    names = {s["name"] for s in spans}
+    assert {"run", "setup", "batch", "attack.stage0", "attack.stage1",
+            "certify", "artifact_io", "finalize"} <= names
+    run_dur = [s for s in spans if s["name"] == "run"][-1]["dur_s"]
+    covered = sum(s["dur_s"] for s in spans if s["depth"] == 1)
+    assert covered / run_dur >= 0.95
+    compiles = [e for e in events if e["kind"] == "compile"]
+    assert any(c["name"].startswith("attack.block") for c in compiles)
+    blocks = [e for e in events if e["kind"] == "block"]
+    assert len(blocks) >= 2  # 4 iterations / sweep_interval 2 per stage
+
+    # heartbeats: at least the immediate beat + the exit beat
+    beats = [json.loads(l)
+             for l in open(os.path.join(rd, "heartbeat_0.jsonl"))]
+    assert len(beats) >= 2 and beats[-1]["phase"] == "exit"
+    assert all(b["run_id"] == manifest["run_id"] for b in beats)
+
+    # metrics records are run_id-stamped for attempt grouping
+    mrecs = [json.loads(l) for l in open(os.path.join(rd, "metrics.jsonl"))]
+    assert mrecs and all(r["run_id"] == manifest["run_id"] for r in mrecs)
+
+    # the offline report renders it without error
+    capsys.readouterr()
+    assert report.main([rd]) == 0
+    out = capsys.readouterr().out
+    assert "phase breakdown" in out and "steps/sec" in out \
+        and "compile time:" in out
+    assert m["evaluated_images"] >= 1

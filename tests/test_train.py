@@ -191,3 +191,20 @@ def test_procedural_rejects_unlearnable_class_counts():
         data_lib.procedural_arrays("cifar100", 2, 32)
     with pytest.raises(ValueError, match="procedural"):
         data_lib.procedural_arrays("imagenet", 2, 224)
+
+
+def test_train_telemetry_unwinds_on_failure(tmp_path):
+    """A failing train run must not leak the process-global active EventLog
+    or the heartbeat daemon: later runs in the same process would write
+    their spans into the stale telemetry dir."""
+    from dorpatch_tpu import observe
+    from dorpatch_tpu.train import TrainConfig, train_victim
+
+    cfg = TrainConfig(data_source="disk", data_dir=str(tmp_path / "missing"))
+    with pytest.raises(Exception):
+        train_victim(cfg, log=lambda *a: None,
+                     telemetry_dir=str(tmp_path / "telemetry"))
+    assert observe.active_event_log() is None
+    # the manifest was written before the failure: the dir still explains
+    # what was attempted
+    assert (tmp_path / "telemetry" / "run.json").exists()

@@ -199,11 +199,13 @@ STEPS = {
 
 
 def parse_train(res):
-    """`train.py` prints `saved <path>; report={...}` on success."""
+    """`train.py` prints `saved <path>; report={...}` on success —
+    possibly behind observe.log's `[pN +T.Ts]` attribution prefix, so
+    match the marker anywhere in the line, not at its start."""
     if res.get("rc") != 0 or ran_on_cpu(res):
         return None
     for line in reversed(res.get("stdout", "").splitlines()):
-        if line.startswith("saved ") and "report=" in line:
+        if "saved " in line and "report=" in line:
             return {"line": line.strip()[:400]}
     return None
 
