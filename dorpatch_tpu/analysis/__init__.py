@@ -1,0 +1,49 @@
+"""Static analysis + runtime sanitizers for the dorpatch-tpu framework.
+
+Two wings, one invariant set:
+
+- **Static** (`engine.py`, `rules_output.py`, `rules_jax.py`, `cli.py`):
+  an AST rule engine with stable `DPxxx` IDs, `# noqa: DPxxx` suppressions,
+  and a CLI gate (`python -m dorpatch_tpu.analysis`, wired into
+  `run_tests.sh`). Catches what is provable from source: bare prints,
+  host syncs under trace, PRNG key reuse, literal seeds, unwrapped jits,
+  unused imports.
+- **Runtime** (`sanitize.py`): the `--sanitize` pipeline flag — NaN
+  debugging, `jax.log_compiles` routed into observe events, and a
+  recompile-budget watchdog that fails the run when a jitted entry point
+  re-traces past its declared budget. Catches what only shows at runtime.
+
+The engine and rules (everything but `sanitize`) are stdlib-only logic —
+ast + tokenize, no jax API calls — so linting never initializes (and on
+shared accelerators, claims) a backend. Importing the package does pull
+jax into the process transitively via the parent package; import alone is
+backend-neutral.
+"""
+
+from dorpatch_tpu.analysis.engine import (  # noqa: F401
+    ALL_CODES,
+    FileContext,
+    Finding,
+    Rule,
+    all_rules,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    get_rule,
+    iter_python_files,
+    register,
+)
+
+__all__ = [
+    "ALL_CODES",
+    "FileContext",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "get_rule",
+    "iter_python_files",
+    "register",
+]

@@ -1,0 +1,7 @@
+"""`python -m dorpatch_tpu.analysis` entry point."""
+
+import sys
+
+from dorpatch_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,96 @@
+"""Lint CLI: `python -m dorpatch_tpu.analysis [paths...]`.
+
+Exit status is the gate contract (`run_tests.sh` runs this before pytest):
+0 = clean, 1 = findings, 2 = usage error. Stdout carries one
+`path:line:col: DPxxx message` line per finding; the summary goes to stderr
+so the finding stream stays machine-parseable.
+
+The lint logic is stdlib-only and calls no jax API (see `engine.py`), so
+the gate never initializes an accelerator backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+from typing import List, Optional
+
+from dorpatch_tpu.analysis.engine import all_rules, analyze_paths
+
+DEFAULT_PATHS = ["dorpatch_tpu", "tools"]
+
+
+def default_paths() -> List[str]:
+    """The no-args targets, resolved so the installed `dorpatch-lint` script
+    works from any cwd: cwd-relative names win (a checkout), otherwise fall
+    back to the installed package location (where `tools` may not exist)."""
+    root = pathlib.Path(__file__).resolve().parents[2]
+    out = []
+    for name in DEFAULT_PATHS:
+        if pathlib.Path(name).exists():
+            out.append(name)
+        elif (root / name).exists():
+            out.append(str(root / name))
+    return out or [str(pathlib.Path(__file__).resolve().parents[1])]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dorpatch_tpu.analysis",
+        description="JAX-aware static analysis for the dorpatch-tpu tree "
+                    "(rules DP101-DP106; see --list-rules)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to lint (default: "
+                        f"{' '.join(DEFAULT_PATHS)})")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule IDs to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("--fixable", action="store_true",
+                   help="list only mechanically fixable offenses")
+    return p
+
+
+def list_rules(out=None) -> None:
+    out = out if out is not None else sys.stdout
+    for rule in all_rules():
+        fix = "fixable" if rule.fixable else "       "
+        out.write(f"{rule.id}  {fix}  {rule.name}: {rule.description}\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        list_rules()
+        return 0
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+        known = {r.id for r in all_rules()}
+        unknown = set(select) - known
+        if unknown:
+            sys.stderr.write(f"unknown rule id(s): {sorted(unknown)}\n")
+            return 2
+    paths = args.paths or default_paths()
+    try:
+        findings = analyze_paths(paths, select=select)
+    except (OSError, UnicodeDecodeError) as e:
+        sys.stderr.write(
+            f"cannot lint {getattr(e, 'filename', None) or paths}: {e}\n")
+        return 2
+    if args.fixable:
+        findings = [f for f in findings if f.fixable]
+    for f in findings:
+        sys.stdout.write(f.render() + "\n")
+    n_fix = sum(1 for f in findings if f.fixable)
+    if findings:
+        sys.stderr.write(
+            f"{len(findings)} finding(s), {n_fix} fixable. Suppress a "
+            "deliberate one with `# noqa: DPxxx <reason>`.\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

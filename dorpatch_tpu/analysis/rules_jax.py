@@ -1,0 +1,443 @@
+"""JAX-aware rules: DP102 host-sync-in-jit, DP103 PRNG key reuse,
+DP104 literal PRNGKey seeds, DP105 unwrapped jax.jit call sites.
+
+What these protect (PAPER.md "EOT inner loop", ROADMAP north star):
+
+- DP102: a jitted entry point that syncs to the host (`.item()`,
+  `float()`/`int()` on a traced array, `np.asarray`, `jax.device_get`,
+  `block_until_ready`) either fails at trace time or — worse — silently
+  forces a device round-trip per step, destroying TPU throughput.
+- DP103: EOT transform/occlusion sampling is i.i.d. only if every
+  `jax.random.*` consumer gets a fresh key; feeding the same key variable to
+  two consumers without an intervening `split` correlates the draws.
+- DP104: seeds must flow from `config.py` (reproducibility is config-keyed,
+  like the results-dir contract); a hard-coded `PRNGKey(<int>)` forks the
+  seed universe. `utils.py` (the seed root) and tests are exempt.
+- DP105: the PR 1 telemetry contract — every `jax.jit` entry point is
+  wrapped in `observe.timed_first_call` so its trace+compile wall time lands
+  in events.jsonl as a `compile` record (and, under `--sanitize`, so the
+  recompile-budget watchdog can see its cache growth).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dorpatch_tpu.analysis.engine import FileContext, Finding, Rule, register
+
+_JIT_TARGETS = {"jax.jit", "jax.pmap"}
+_LOOP_TARGETS = {"jax.lax.scan", "jax.lax.fori_loop", "jax.lax.while_loop"}
+_PARTIAL_TARGETS = {"functools.partial", "partial"}
+
+# jax.random.* functions that are not draw-consumers of their key argument:
+# constructors, key plumbing, and `split`/`fold_in` (which *derive* keys).
+_NON_CONSUMERS = {"PRNGKey", "key", "key_data", "wrap_key_data", "key_impl",
+                  "split", "fold_in", "clone"}
+
+
+def _parent_map(tree: ast.AST) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _is_jit_expr(ctx: FileContext, node: ast.AST) -> bool:
+    """True for an expression that evaluates to jax.jit: `jax.jit` itself or
+    `partial(jax.jit, ...)` (decorator idiom for static_argnums etc.)."""
+    if ctx.resolve(node) in _JIT_TARGETS:
+        return True
+    if (isinstance(node, ast.Call)
+            and ctx.resolve(node.func) in _PARTIAL_TARGETS
+            and node.args and ctx.resolve(node.args[0]) in _JIT_TARGETS):
+        return True
+    return False
+
+
+def _jit_context_functions(ctx: FileContext) -> List[ast.AST]:
+    """Function/lambda nodes whose bodies execute under trace: jit-decorated
+    defs, defs passed to `jax.jit(...)`, and `lax.scan`/`fori_loop`/
+    `while_loop` body functions (by local name or inline lambda)."""
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    contexts: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def add(node: Optional[ast.AST]) -> None:
+        if node is not None and id(node) not in seen:
+            seen.add(id(node))
+            contexts.append(node)
+
+    def add_ref(arg: ast.AST) -> None:
+        if isinstance(arg, ast.Lambda):
+            add(arg)
+        elif isinstance(arg, ast.Name):
+            for d in defs_by_name.get(arg.id, []):
+                add(d)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(ctx, dec) for dec in node.decorator_list):
+                add(node)
+        elif isinstance(node, ast.Call):
+            target = ctx.resolve(node.func)
+            if target in _JIT_TARGETS and node.args:
+                add_ref(node.args[0])
+            elif target in _LOOP_TARGETS:
+                # scan(body, ...) / while_loop(cond, body, ...) /
+                # fori_loop(lo, hi, body, ...): every callable positional
+                # argument is a traced body
+                for arg in node.args:
+                    add_ref(arg)
+    return contexts
+
+
+def _mentions_static_attr(node: ast.AST) -> bool:
+    """Heuristic: expressions over `.shape`/`.ndim`/`.size` or `len()` are
+    static under trace — `int(x.shape[0])` is fine inside jit."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim",
+                                                           "size", "dtype"):
+            return True
+        if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                and sub.func.id == "len"):
+            return True
+    return False
+
+
+@register
+class HostSyncInJitRule(Rule):
+    id = "DP102"
+    name = "host-sync-in-jit"
+    description = ("host-synchronizing call inside a jax.jit-decorated "
+                   "function or lax.scan/fori_loop/while_loop body")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        emitted: Set[Tuple[int, int, str]] = set()
+        for fn in _jit_context_functions(ctx):
+            for node in ast.walk(fn):
+                msg = self._offense(ctx, node)
+                if msg is None:
+                    continue
+                key = (node.lineno, node.col_offset, msg)
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                yield self.finding(ctx, node, msg)
+
+    def _offense(self, ctx: FileContext, node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "item":
+                return ".item() forces a device->host sync under trace"
+            if node.func.attr == "block_until_ready":
+                return "block_until_ready() is a host sync — illegal under trace"
+        target = ctx.resolve(node.func)
+        if target in ("jax.device_get", "jax.block_until_ready"):
+            return f"{target}() is a host sync — illegal under trace"
+        if target in ("numpy.asarray", "numpy.array"):
+            return (f"{target}() materializes a traced array on the host; "
+                    "use jnp inside jit")
+        if (isinstance(node.func, ast.Name) and node.func.id in ("float", "int")
+                and len(node.args) == 1):
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) or _mentions_static_attr(arg):
+                return None
+            return (f"{node.func.id}() on a (likely traced) value is a "
+                    "concretization host sync under trace")
+        return None
+
+
+class _KeyScopeWalker:
+    """Linear-order key-use tracker for one function (or module) scope.
+
+    State is the set of key variable names already fed to a `jax.random.*`
+    consumer; a second consumer use without an intervening REBINDING of
+    that name (the split idiom `key, sub = jax.random.split(key)`, or any
+    other assignment) is a DP103 offense — an unbound `split(key)` call
+    does not refresh the name. `if`/`else` branches each run against a copy
+    of the state and merge by replacing with the union of branch-final
+    states (consumed on any path stays consumed; rebound on every path is
+    fresh). Loop bodies are walked twice so loop-invariant reuse across
+    iterations is caught. Nested function bodies are separate scopes,
+    walked independently by the rule.
+    """
+
+    def __init__(self, rule: "KeyReuseRule", ctx: FileContext):
+        self.rule = rule
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+
+    def walk_scope(self, body: List[ast.stmt]) -> None:
+        self._walk_body(body, set())
+
+    def _walk_body(self, body: List[ast.stmt], used: Set[str]) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, used)
+
+    def _walk_stmt(self, stmt: ast.stmt, used: Set[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate scope
+        if isinstance(stmt, ast.If):
+            self._scan_exprs([stmt.test], used)  # test evaluates first
+            branch_states = []
+            for branch in (stmt.body, stmt.orelse):
+                s = set(used)
+                self._walk_body(branch, s)
+                branch_states.append(s)
+            # REPLACE with the union of branch-final states: consumed on any
+            # path stays consumed, but a key re-derived (split/rebound) in
+            # every branch is genuinely fresh afterwards
+            used.clear()
+            used.update(branch_states[0] | branch_states[1])
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            # two passes over the body: the second models the next iteration,
+            # catching the canonical loop-invariant reuse (`for i in ...:
+            # jax.random.normal(key, ...)` draws correlated samples every
+            # pass). Duplicate findings from re-walking dedupe in check().
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_exprs([stmt.iter], used)  # iter evaluates ONCE
+                for _ in range(2):
+                    # the loop target rebinds each iteration (e.g. `for key
+                    # in jax.random.split(master, n):`) — fresh every pass
+                    for name in self._names_in(stmt.target):
+                        used.discard(name)
+                    self._walk_body(stmt.body, used)
+            else:
+                for _ in range(2):  # a while-test re-evaluates per pass
+                    self._scan_exprs([stmt.test], used)
+                    self._walk_body(stmt.body, used)
+            self._walk_body(stmt.orelse, used)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, used)
+            for h in stmt.handlers:
+                self._walk_body(h.body, used)
+            self._walk_body(stmt.orelse, used)
+            self._walk_body(stmt.finalbody, used)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._scan_exprs([i.context_expr for i in stmt.items], used)
+            for item in stmt.items:  # `with ... as key:` rebinds
+                if item.optional_vars is not None:
+                    for name in self._names_in(item.optional_vars):
+                        used.discard(name)
+            self._walk_body(stmt.body, used)
+            return
+        # simple statement: consumer calls first (RHS evaluates before the
+        # store), then name bindings reset their state
+        self._scan_exprs([stmt], used)
+        for name in self._stored_names(stmt):
+            used.discard(name)
+
+    @staticmethod
+    def _walk_without_lambdas(root: ast.AST):
+        """ast.walk, but do not descend into lambda bodies: a lambda's draws
+        happen at CALL time, not at the definition site, and each lambda is
+        already collected as its own scope by KeyReuseRule.check."""
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, ast.Lambda):
+                    stack.append(child)
+
+    def _scan_exprs(self, nodes: List[ast.AST], used: Set[str]) -> None:
+        for root in nodes:
+            if root is None:
+                continue
+            for node in self._walk_without_lambdas(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = self.ctx.resolve(node.func)
+                if not target or not target.startswith("jax.random."):
+                    continue
+                tail = target.rsplit(".", 1)[1]
+                if not node.args or not isinstance(node.args[0], ast.Name):
+                    continue
+                key_name = node.args[0].id
+                if tail not in _NON_CONSUMERS:
+                    # NOTE: `split`/`fold_in`/`clone` are non-consumers but
+                    # do NOT refresh the name by themselves — only REBINDING
+                    # does (`key, sub = split(key)`), which the stored-names
+                    # pass handles. `use(key); split(key); use(key)` keeps
+                    # consuming the same key and still flags.
+                    if key_name in used:
+                        self.findings.append(self.rule.finding(
+                            self.ctx, node,
+                            f"key {key_name!r} already consumed by a "
+                            f"jax.random call — split it before jax.random."
+                            f"{tail} (EOT draws must stay i.i.d.)"))
+                    else:
+                        used.add(key_name)
+
+    @staticmethod
+    def _stored_names(stmt: ast.stmt) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                names.add(node.id)
+        return names
+
+    @staticmethod
+    def _names_in(target: ast.AST) -> Set[str]:
+        """All Name identifiers in a binding target (handles tuples)."""
+        return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+
+
+@register
+class KeyReuseRule(Rule):
+    id = "DP103"
+    name = "prng-key-reuse"
+    description = ("same PRNG key variable fed to two jax.random.* "
+                   "consumers without an intervening split")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        scopes: List[List[ast.stmt]] = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+            elif isinstance(node, ast.Lambda):
+                scopes.append([ast.Expr(value=node.body)])
+        seen = set()
+        for body in scopes:
+            w = _KeyScopeWalker(self, ctx)
+            w.walk_scope(body)
+            for f in w.findings:
+                # the loop-body second pass re-visits call sites; one
+                # finding per location
+                if (f.line, f.col) not in seen:
+                    seen.add((f.line, f.col))
+                    yield f
+
+
+@register
+class LiteralSeedRule(Rule):
+    id = "DP104"
+    name = "literal-prng-seed"
+    description = ("literal jax.random.PRNGKey(<int>) outside utils.py/"
+                   "tests — seeds must flow from config.py (via "
+                   "utils.set_global_seed / utils.global_key)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.in_tests():
+            return
+        # only the package-root utils.py (home of set_global_seed/global_key)
+        # may construct literal keys — not any file that happens to be
+        # named utils.py deeper in the tree
+        if ctx.scoped_parts == ("utils.py",):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.resolve(node.func) not in ("jax.random.PRNGKey",
+                                              "jax.random.key"):
+                continue
+            if (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, int)):
+                yield self.finding(
+                    ctx, node,
+                    f"hard-coded PRNGKey({node.args[0].value!r}) — derive "
+                    "the key from the config seed (utils.global_key)")
+
+
+@register
+class UnwrappedJitRule(Rule):
+    id = "DP105"
+    name = "unwrapped-jit"
+    description = ("jax.jit entry point not wrapped by "
+                   "observe.timed_first_call — its compile time is invisible "
+                   "to the telemetry layer and the recompile watchdog")
+
+    _MSG = ("jax.jit call site not wrapped by observe.timed_first_call "
+            "(PR 1 telemetry contract: compile wall time must land in "
+            "events.jsonl)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        parents = _parent_map(ctx.tree)
+
+        wrapped_names: Set[str] = set()
+        wrapped_nodes: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve(node.func)
+            if not target or not target.split(".")[-1] == "timed_first_call":
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                wrapped_names.add(arg.id)
+            else:
+                wrapped_nodes.add(id(arg))
+
+        # call-form sites: jax.jit(fn, ...)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and ctx.resolve(node.func) in _JIT_TARGETS):
+                continue
+            if id(node) in wrapped_nodes:
+                continue
+            parent = parents.get(id(node))
+            bound = self._bound_name(parent, node)
+            if bound is not None and bound in wrapped_names:
+                continue
+            if self._is_decorator(parents, node):
+                fn = self._decorated_function(parents, node)
+                if fn is not None and fn.name in wrapped_names:
+                    continue
+            yield self.finding(ctx, node, self._MSG)
+
+        # decorator-form sites: @jax.jit / @partial(jax.jit, ...)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    # `@jax.jit` as bare attribute handled here; the Call
+                    # forms (`@partial(jax.jit, ...)`, `@jax.jit(...)`) were
+                    # already covered by the call-form walk above
+                    if not (ctx.resolve(dec.func) in _PARTIAL_TARGETS
+                            and dec.args
+                            and ctx.resolve(dec.args[0]) in _JIT_TARGETS):
+                        continue
+                elif ctx.resolve(dec) not in _JIT_TARGETS:
+                    continue
+                if node.name in wrapped_names:
+                    continue
+                yield self.finding(ctx, dec, self._MSG)
+
+    @staticmethod
+    def _bound_name(parent: Optional[ast.AST], node: ast.AST) -> Optional[str]:
+        if isinstance(parent, ast.Assign) and parent.value is node \
+                and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], ast.Name):
+            return parent.targets[0].id
+        if isinstance(parent, (ast.AnnAssign, ast.AugAssign)) \
+                and getattr(parent, "value", None) is node \
+                and isinstance(parent.target, ast.Name):
+            return parent.target.id
+        return None
+
+    @staticmethod
+    def _is_decorator(parents: Dict[int, ast.AST], node: ast.AST) -> bool:
+        parent = parents.get(id(node))
+        return isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+            and node in parent.decorator_list
+
+    @staticmethod
+    def _decorated_function(parents: Dict[int, ast.AST], node: ast.AST):
+        parent = parents.get(id(node))
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return parent
+        return None

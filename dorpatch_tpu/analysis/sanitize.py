@@ -1,0 +1,148 @@
+"""Runtime sanitizers: what static rules cannot prove, checked live.
+
+The static wing (`rules_jax.py`) catches source-provable invariant breaks;
+this module catches the rest at runtime, behind the pipeline's `--sanitize`
+flag (`ExperimentConfig.sanitize`):
+
+- **NaN debugging** — `jax_debug_nans`: any NaN produced inside a jitted
+  program re-runs op-by-op and raises `FloatingPointError` at the producing
+  primitive, instead of silently poisoning the carry state for the rest of
+  a 5000-iteration attack.
+- **Compile logging** — `jax_log_compiles` routed into observe events:
+  every trace+compile jax performs lands in `events.jsonl` as a
+  `jax.log_compiles` event, so the report CLI can show *unexpected*
+  recompiles next to the declared `compile` records the
+  `timed_first_call` wrappers emit.
+- **Recompile-budget watchdog** — every jitted entry point wrapped by
+  `observe.timed_first_call(..., recompile_budget=N)` declares how many
+  traces (shape/dtype buckets) it is allowed. The watchdog reads the jit's
+  `_cache_size()` after each call and FAILS THE RUN (`RecompileBudgetExceeded`)
+  when the cache outgrows the budget — a shape-unstable call pattern
+  (e.g. an unpadded dynamic batch) otherwise re-traces every step and
+  turns a TPU run into a compile loop.
+
+Unlike the rest of the analysis package this module imports jax; only the
+runtime pipeline (and tests) load it.
+
+Usage:
+
+    with Sanitizer():          # or: python -m dorpatch_tpu.cli --sanitize
+        run_experiment(cfg)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import jax
+
+from dorpatch_tpu import observe
+from dorpatch_tpu.observe import events as _events
+
+
+class RecompileBudgetExceeded(RuntimeError):
+    """A jitted entry point re-traced past its declared budget."""
+
+
+class RecompileWatchdog:
+    """Per-entry-point trace accounting against declared budgets.
+
+    Installed via `observe.set_recompile_guard`; `observe.timed_first_call`
+    wrappers call `after_call` after every invocation. `_cache_size()` is
+    the number of (shape, dtype, static-arg) buckets the jit has compiled —
+    exactly "traces so far", with no log parsing. Jits that don't expose it
+    (plain callables, mocks) are skipped.
+    """
+
+    def __init__(self):
+        self._last_seen: Dict[str, int] = {}
+
+    def after_call(self, name: str, wrapped, budget: Optional[int]) -> None:
+        try:
+            traces = int(wrapped._cache_size())
+        except (AttributeError, TypeError):
+            return
+        prev = self._last_seen.get(name, 0)
+        if traces > prev:
+            self._last_seen[name] = traces
+            if prev >= 1:
+                # growth past the first trace is a re-trace: always recorded,
+                # only fatal past the budget
+                observe.record_event("sanitize.retrace", entry=name,
+                                     traces=traces,
+                                     budget=-1 if budget is None else budget)
+        if budget is not None and traces > budget:
+            observe.record_event("sanitize.recompile_budget_exceeded",
+                                 entry=name, traces=traces, budget=budget)
+            raise RecompileBudgetExceeded(
+                f"jitted entry point {name!r} traced {traces} times, over "
+                f"its declared budget of {budget} (shape-unstable call "
+                "pattern? every distinct input shape/dtype is a fresh XLA "
+                "compile)")
+
+
+class _CompileLogHandler(logging.Handler):
+    """Forwards jax's log_compiles records into the active EventLog."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:
+            return
+        # log_compiles emits ~4 records per compile (trace, MLIR, XLA,
+        # dispatch); keep exactly the one-per-compile "Compiling <fn> with
+        # global shapes..." line so events.jsonl stays readable
+        if not msg.startswith("Compiling"):
+            return
+        observe.record_event("jax.log_compiles", logger=record.name,
+                             message=msg[:500])
+
+
+class Sanitizer:
+    """Context manager arming the runtime sanitizers; restores every global
+    it touched (jax config flags, the log handler, the recompile guard) on
+    exit, so tests and nested runs never leak sanitizer state."""
+
+    def __init__(self, debug_nans: bool = True, log_compiles: bool = True,
+                 recompile_budgets: bool = True):
+        self.debug_nans = debug_nans
+        self.log_compiles = log_compiles
+        self.recompile_budgets = recompile_budgets
+        self.watchdog = RecompileWatchdog() if recompile_budgets else None
+        self._handler: Optional[_CompileLogHandler] = None
+        self._prev_flags: Dict[str, bool] = {}
+        self._prev_guard = None
+
+    def __enter__(self) -> "Sanitizer":
+        if self.debug_nans:
+            self._set_flag("jax_debug_nans", True)
+        if self.log_compiles:
+            self._set_flag("jax_log_compiles", True)
+            self._handler = _CompileLogHandler(level=logging.WARNING)
+            # log_compiles messages are emitted at WARNING on the jax.*
+            # loggers (pjit tracing, dispatch); one handler on the parent
+            # catches them all
+            logging.getLogger("jax").addHandler(self._handler)
+        if self.watchdog is not None:
+            self._prev_guard = _events.recompile_guard()
+            _events.set_recompile_guard(self.watchdog)
+        observe.record_event(
+            "sanitize.enabled", debug_nans=self.debug_nans,
+            log_compiles=self.log_compiles,
+            recompile_budgets=self.recompile_budgets)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.watchdog is not None:
+            _events.set_recompile_guard(self._prev_guard)
+        if self._handler is not None:
+            logging.getLogger("jax").removeHandler(self._handler)
+            self._handler = None
+        for flag, prev in self._prev_flags.items():
+            jax.config.update(flag, prev)
+        self._prev_flags.clear()
+
+    def _set_flag(self, flag: str, value: bool) -> None:
+        self._prev_flags[flag] = bool(getattr(jax.config, flag))
+        jax.config.update(flag, value)

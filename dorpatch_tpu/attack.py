@@ -41,6 +41,7 @@ from dorpatch_tpu import losses
 from dorpatch_tpu import masks as masks_lib
 from dorpatch_tpu import observe
 from dorpatch_tpu import ops
+from dorpatch_tpu import utils
 from dorpatch_tpu.config import AttackConfig
 from dorpatch_tpu.defense import masked_predictions
 
@@ -160,6 +161,11 @@ class DorPatch:
     # mask-fill on multi-chip meshes via its shard_map wrapper
     # (`ops.masked_fill`); see parallel.make_sharded_attack
     mesh: Optional[Any] = None
+    # declared trace budget per jitted entry point: distinct image-batch
+    # sizes are the only legitimate shape buckets (the driver's correctness
+    # filter makes B dynamic), so the pipeline declares cfg.batch_size.
+    # Inert unless the runtime sanitizer is armed (analysis/sanitize.py).
+    recompile_budget: Optional[int] = None
 
     def __post_init__(self):
         cfg = self.config
@@ -471,7 +477,8 @@ class DorPatch:
             # telemetry: the first call pays trace+XLA compile; record it as
             # a `compile` event on whatever EventLog the driver activated
             self._programs[key] = observe.timed_first_call(
-                run_block, f"attack.block.stage{stage}.steps{n_steps}")
+                run_block, f"attack.block.stage{stage}.steps{n_steps}",
+                recompile_budget=self.recompile_budget)
         return self._programs[key]
 
     def sweep_failures(self, adv_mask, adv_pattern, x, y, targeted, universe) -> jax.Array:
@@ -494,7 +501,8 @@ class DorPatch:
                 return jnp.any(fail_per_img, axis=0)
 
             self._programs["sweep"] = observe.timed_first_call(
-                sweep, "attack.sweep")
+                sweep, "attack.sweep",
+                recompile_budget=self.recompile_budget)
         return self._programs["sweep"](adv_mask, adv_pattern, x, y, targeted, universe)
 
     # ---------- host orchestration ----------
@@ -510,8 +518,11 @@ class DorPatch:
             adv_pattern=jax.random.uniform(k_pat, (b, h, w, 3)),
             best_mask=jnp.zeros((b, h, w, 1)),
             best_pattern=jnp.zeros((b, h, w, 3)),
-            loss_best=jnp.full((b,), jnp.inf),
-            lr=jnp.full((b,), cfg.lr),
+            # explicit dtype: a weak-typed init (python-float inf) retraces
+            # every block program once when the strong-typed carry comes
+            # back around — caught by the recompile watchdog (--sanitize)
+            loss_best=jnp.full((b,), jnp.inf, jnp.float32),
+            lr=jnp.full((b,), cfg.lr, jnp.float32),
             not_decay=jnp.zeros((b,), jnp.int32),
             num_failure=jnp.asarray(universe_size + 1, jnp.int32),
             failed=jnp.zeros((universe_size,), bool),
@@ -530,8 +541,8 @@ class DorPatch:
         cfg = self.config
         b = state.lr.shape[0]
         return state._replace(
-            lr=jnp.full((b,), cfg.lr),
-            loss_best=jnp.full((b,), jnp.inf),
+            lr=jnp.full((b,), cfg.lr, jnp.float32),
+            loss_best=jnp.full((b,), jnp.inf, jnp.float32),  # strong: see _init_state
             not_decay=jnp.zeros((b,), jnp.int32),
             num_failure=jnp.asarray(universe_size + 1, jnp.int32),
         )
@@ -630,7 +641,9 @@ class DorPatch:
         """
         cfg = self.config
         if key is None:
-            key = jax.random.PRNGKey(0)
+            # derive from the configured seed (utils.set_global_seed), not a
+            # hard-coded PRNGKey literal — rule DP104
+            key = utils.global_key()
         img_size = x.shape[1]
         universe = jnp.asarray(
             masks_lib.dropout_universe(img_size, cfg.dropout, cfg.dropout_sizes)

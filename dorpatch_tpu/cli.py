@@ -7,7 +7,6 @@ Run:  python -m dorpatch_tpu.cli --dataset cifar10 --synthetic ...
 from __future__ import annotations
 
 import argparse
-import dataclasses
 
 from dorpatch_tpu.config import AttackConfig, DefenseConfig, ExperimentConfig
 
@@ -66,6 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-mask", type=int, default=1)
     p.add_argument("--trace-dir", default="",
                    help="write a jax.profiler trace of the run here")
+    p.add_argument("--sanitize", action="store_true",
+                   help="arm the runtime sanitizers: jax debug_nans, "
+                        "log_compiles routed into observe events, and the "
+                        "recompile-budget watchdog (fails the run when a "
+                        "jitted entry point re-traces past its declared "
+                        "budget); debugging runs only — costs throughput")
     p.add_argument("--no-metrics-log", action="store_true",
                    help="disable run telemetry (metrics JSONL, events "
                         "JSONL span log, heartbeats) in the results dir")
@@ -157,6 +162,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         mesh_data=args.mesh_data,
         mesh_mask=args.mesh_mask,
         metrics_log=not args.no_metrics_log,
+        sanitize=args.sanitize,
         trace_dir=args.trace_dir,
         hang_timeout=args.hang_timeout,
         heartbeat_interval=args.heartbeat_interval,

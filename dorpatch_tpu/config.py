@@ -180,6 +180,14 @@ class ExperimentConfig:
     # consumes (`observe/report.py`). run.json is always written (a results
     # dir must stay self-describing even with telemetry off).
     metrics_log: bool = True
+    # Runtime sanitizers (analysis/sanitize.py): jax_debug_nans (fail at the
+    # NaN-producing primitive), jax_log_compiles routed into observe events,
+    # and the recompile-budget watchdog (each jitted entry point declares
+    # its trace budget via timed_first_call; exceeding it fails the run).
+    # Static rules (python -m dorpatch_tpu.analysis) catch what is provable
+    # from source; this flag catches the rest live. Costs throughput —
+    # debugging runs only.
+    sanitize: bool = False
     trace_dir: str = ""
     heartbeat_interval: float = 5.0  # seconds between heartbeat beats
     hang_timeout: float = 0.0       # >0 arms the watchdog: abort (with every

@@ -236,6 +236,10 @@ class PatchCleanser:
     # optional (data, mask) mesh: keeps the fused Pallas mask-fill sharded
     # on multi-chip meshes (see ops.masked_fill)
     mesh: Any = None
+    # declared trace budget for the jitted 666-mask sweep: one bucket per
+    # distinct image-batch size (the driver's correctness filter makes B
+    # dynamic). Enforced only under --sanitize (analysis/sanitize.py).
+    recompile_budget: Any = None
 
     def __post_init__(self):
         singles, doubles = masks_lib.mask_sets(self.spec)
@@ -271,7 +275,8 @@ class PatchCleanser:
         # sweep; recorded as a `compile` event on the driver's EventLog
         self._predict = observe.timed_first_call(
             jax.jit(_predict, static_argnums=2, out_shardings=out_shardings),
-            f"defense.predict.r{self.spec.patch_ratio}")
+            f"defense.predict.r{self.spec.patch_ratio}",
+            recompile_budget=self.recompile_budget)
 
     def robust_predict(
         self, params, imgs: jax.Array, num_classes: int
@@ -294,7 +299,8 @@ class PatchCleanser:
 
 
 def build_defenses(
-    apply_fn, img_size: int, config: DefenseConfig = DefenseConfig(), mesh=None
+    apply_fn, img_size: int, config: DefenseConfig = DefenseConfig(),
+    mesh=None, recompile_budget=None,
 ) -> List[PatchCleanser]:
     """The reference driver's 4-radius defense bank (`/root/reference/main.py:61`)."""
     return [
@@ -303,6 +309,7 @@ def build_defenses(
             masks_lib.geometry(img_size, r, config.n_patch, config.num_mask_per_axis),
             config,
             mesh=mesh,
+            recompile_budget=recompile_budget,
         )
         for r in config.ratios
     ]

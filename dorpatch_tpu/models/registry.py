@@ -15,6 +15,7 @@ from typing import Any, Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from dorpatch_tpu import observe
 from dorpatch_tpu.config import NUM_CLASSES
 
 # Supported timm model names, matched by substring as in the reference.
@@ -148,7 +149,9 @@ def get_model(
         dummy = jnp.zeros((1, img_size, img_size, 3), jnp.float32)
         # jit the initializer: eager init dispatches hundreds of tiny ops,
         # which is pathologically slow over remote-tunneled TPU backends
-        params = jax.jit(model.init)(jax.random.PRNGKey(seed), dummy)
+        params = observe.timed_first_call(
+            jax.jit(model.init), f"model.init.{timm_name}",
+            recompile_budget=1)(jax.random.PRNGKey(seed), dummy)
         from_checkpoint = False
 
     def apply(params, images01):

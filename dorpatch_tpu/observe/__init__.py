@@ -43,6 +43,8 @@ from dorpatch_tpu.observe.events import (  # noqa: F401
     events_filename,
     record_compile,
     record_event,
+    recompile_guard,
+    set_recompile_guard,
     span,
     timed_first_call,
 )
@@ -83,8 +85,10 @@ __all__ = [
     "read_heartbeats",
     "record_compile",
     "record_event",
+    "recompile_guard",
     "run_manifest",
     "set_process_index",
+    "set_recompile_guard",
     "span",
     "summarize_heartbeats",
     "timed_first_call",

@@ -254,36 +254,67 @@ def record_compile(name: str, seconds: float) -> None:
         el.compile(name, seconds)
 
 
+# ---------------- recompile guard hook (analysis/sanitize.py) ----------------
+
+# Installed by the runtime sanitizer (`dorpatch_tpu.analysis.sanitize`): an
+# object with `after_call(name, wrapped, budget)` inspected after EVERY call
+# through a _FirstCallTimer. Lives here (not in analysis/) so observe never
+# imports the analysis package; None means no enforcement.
+_RECOMPILE_GUARD = None
+
+
+def set_recompile_guard(guard) -> None:
+    global _RECOMPILE_GUARD
+    _RECOMPILE_GUARD = guard
+
+
+def recompile_guard():
+    return _RECOMPILE_GUARD
+
+
 class _FirstCallTimer:
     """Callable proxy recording the wrapped fn's first-call wall time as a
     `compile` event. Unknown attributes delegate to the wrapped callable, so
     a wrapped `jax.jit` object keeps its full API (`.lower()`, `.trace()`,
     ... — the HLO-inspection tests and tools rely on it)."""
 
-    def __init__(self, fn, name: str, clock):
+    def __init__(self, fn, name: str, clock, recompile_budget=None):
         self.__wrapped__ = fn
         self._name = name
         self._clock = clock
         self._done = False
+        self.recompile_budget = recompile_budget
         functools.update_wrapper(self, fn, updated=())
 
     def __call__(self, *args, **kwargs):
         if self._done:
-            return self.__wrapped__(*args, **kwargs)
-        self._done = True
-        t0 = self._clock()
-        out = self.__wrapped__(*args, **kwargs)
-        record_compile(self._name, self._clock() - t0)
+            out = self.__wrapped__(*args, **kwargs)
+        else:
+            self._done = True
+            t0 = self._clock()
+            out = self.__wrapped__(*args, **kwargs)
+            record_compile(self._name, self._clock() - t0)
+        guard = _RECOMPILE_GUARD
+        if guard is not None:
+            guard.after_call(self._name, self.__wrapped__,
+                             self.recompile_budget)
         return out
 
     def __getattr__(self, item):
         return getattr(self.__wrapped__, item)
 
 
-def timed_first_call(fn, name: str, clock=time.perf_counter):
+def timed_first_call(fn, name: str, clock=time.perf_counter,
+                     recompile_budget=None):
     """Wrap a jitted callable so its FIRST invocation's wall time is
     recorded as a `compile` event (trace + XLA compile happen synchronously
     inside that call; execution dispatch is the tail). Subsequent calls pass
     through untimed. Recording goes to whatever EventLog is active at
-    first-call time — none active, nothing recorded."""
-    return _FirstCallTimer(fn, name, clock)
+    first-call time — none active, nothing recorded.
+
+    `recompile_budget` declares how many traces (shape/dtype buckets) this
+    entry point is allowed — its `_cache_size()` upper bound. It is inert
+    until the runtime sanitizer installs a recompile guard
+    (`--sanitize`; `analysis/sanitize.py`), which then checks the wrapped
+    jit's cache growth after every call and fails the run on excess."""
+    return _FirstCallTimer(fn, name, clock, recompile_budget)

@@ -171,7 +171,14 @@ def _sharded_masked_fill_fn(fill: float, interpret: bool, mesh,
     forward wants. The backward kernel accumulates per-shard image cotangents
     and `psum`s them over the mask axis — the one collective this op needs.
     """
-    from jax import shard_map
+    try:
+        # jax >= 0.6: public API; the replication check kwarg is check_vma
+        from jax import shard_map
+        sm_kwargs = {"check_vma": False}
+    except ImportError:
+        # jax 0.4.x: experimental API, same semantics, kwarg is check_rep
+        from jax.experimental.shard_map import shard_map
+        sm_kwargs = {"check_rep": False}
     from jax.sharding import PartitionSpec as P
 
     im_spec = P(data_axis)             # [B,H,W,C]: data-sharded, mask-replicated
@@ -181,12 +188,12 @@ def _sharded_masked_fill_fn(fill: float, interpret: bool, mesh,
     fwd_sm = shard_map(
         lambda im, rc: _pallas_fwd(im, rc, fill, interpret),
         mesh=mesh, in_specs=(im_spec, rc_spec), out_specs=out_spec,
-        check_vma=False,
+        **sm_kwargs,
     )
     bwd_sm = shard_map(
         lambda rc, g: jax.lax.psum(_pallas_bwd(rc, g, interpret), mask_axis),
         mesh=mesh, in_specs=(rc_spec, out_spec), out_specs=im_spec,
-        check_vma=False,
+        **sm_kwargs,
     )
 
     @jax.custom_vjp

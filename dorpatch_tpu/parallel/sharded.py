@@ -58,12 +58,13 @@ def make_sharded_defenses(
     img_size: int,
     mesh: Mesh,
     config: DefenseConfig = DefenseConfig(),
+    recompile_budget=None,
 ) -> List[PatchCleanser]:
     """The 4-radius defense bank with certification sweeps sharded over the
     mesh (chunk axis splits across chips; the per-chunk forward is the unit
     of scatter, as in the attack)."""
     return build_defenses(shard_apply_fn(apply_fn, mesh), img_size, config,
-                          mesh=mesh)
+                          mesh=mesh, recompile_budget=recompile_budget)
 
 
 __all__ = [

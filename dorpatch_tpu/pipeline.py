@@ -130,6 +130,14 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             stack.enter_context(hb)
             if watchdog is not None:
                 stack.enter_context(watchdog)
+        if cfg.sanitize:
+            # runtime sanitizers (analysis/sanitize.py): debug_nans,
+            # log_compiles -> events.jsonl, recompile-budget watchdog.
+            # Entered after the EventLog activates so sanitizer events land
+            # in the telemetry stream (no-op sinks when telemetry is off).
+            from dorpatch_tpu.analysis.sanitize import Sanitizer
+
+            stack.enter_context(Sanitizer())
         stack.enter_context(observe.trace(cfg.trace_dir))
         stack.enter_context(logger)
         stack.enter_context(
@@ -138,19 +146,27 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
         with observe.span("setup"):
             victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir,
                                cfg.img_size, gn_impl=cfg.gn_impl)
+            # declared trace budget per jitted entry point: the correctness
+            # filter makes the surviving batch dynamic, so distinct batch
+            # sizes (1..batch_size) are the only legitimate shape buckets.
+            # Enforced by the recompile watchdog under --sanitize.
+            budget = int(cfg.batch_size)
             mesh = None
             if cfg.mesh_data * cfg.mesh_mask > 1:
                 mesh = parallel.make_mesh(cfg.mesh_data, cfg.mesh_mask)
                 defenses = parallel.make_sharded_defenses(
-                    victim.apply, cfg.img_size, mesh, cfg.defense)
+                    victim.apply, cfg.img_size, mesh, cfg.defense,
+                    recompile_budget=budget)
                 attack = parallel.make_sharded_attack(
                     victim.apply, victim.params, victim.num_classes,
-                    cfg.attack, mesh)
+                    cfg.attack, mesh, recompile_budget=budget)
             else:
                 defenses = build_defenses(victim.apply, cfg.img_size,
-                                          cfg.defense)
+                                          cfg.defense,
+                                          recompile_budget=budget)
                 attack = DorPatch(victim.apply, victim.params,
-                                  victim.num_classes, cfg.attack)
+                                  victim.num_classes, cfg.attack,
+                                  recompile_budget=budget)
             attack.on_block_end = _on_block
 
         preds_list: List[np.ndarray] = []

@@ -152,7 +152,8 @@ def _train_victim_impl(cfg: TrainConfig, arch_name: str,
 
     model = registry.build_bare_model(arch_name, n_classes)
     key = jax.random.PRNGKey(cfg.seed)
-    params = jax.jit(model.init)(
+    params = observe.timed_first_call(
+        jax.jit(model.init), "train.init", recompile_budget=1)(
         key, jnp.zeros((1, cfg.img_size, cfg.img_size, 3)))
 
     steps_per_epoch = len(tr_x) // cfg.batch_size
@@ -187,11 +188,20 @@ def _train_victim_impl(cfg: TrainConfig, arch_name: str,
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss, acc
 
+    # telemetry contract (DP105): entry-point compiles land in events.jsonl.
+    # Budgets: the train batch shape never changes (1 bucket); eval runs
+    # full 500-image chunks plus at most one remainder chunk (2 buckets).
+    train_step = observe.timed_first_call(
+        train_step, "train.step", recompile_budget=1)
+
     @jax.jit
     def eval_step(params, x_u8, y):
         logits = model.apply(
             params, (x_u8.astype(jnp.float32) / 255.0 - 0.5) / 0.5)
         return (logits.argmax(-1) == y).sum()
+
+    eval_step = observe.timed_first_call(
+        eval_step, "train.eval_step", recompile_budget=2)
 
     # uint8 on device: 4x less HBM/L2 traffic than f32, cast inside the jit
     dev_tr_x = jax.device_put((tr_x * 255).astype(np.uint8))

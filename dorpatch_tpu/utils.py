@@ -38,12 +38,31 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
     return d
 
 
+_GLOBAL_SEED = 1234  # last seed handed to set_global_seed (config default)
+
+
 def set_global_seed(seed: int = 1234) -> jax.Array:
     """Seed host-side RNGs (python, numpy legacy) and return the root
-    `PRNGKey` all device-side randomness should be split from."""
+    `PRNGKey` all device-side randomness should be split from. Also records
+    the seed so `global_key` can re-derive the root key anywhere."""
+    global _GLOBAL_SEED
+    _GLOBAL_SEED = int(seed)
     random.seed(seed)
     np.random.seed(seed)
     return jax.random.PRNGKey(seed)
+
+
+def global_key(fold: int = 0) -> jax.Array:
+    """Root PRNG key derived from the configured seed (the last
+    `set_global_seed` call — the pipeline seeds it from `config.seed`).
+
+    This is the sanctioned fallback for components that need a key but were
+    not handed one: seeds must flow from the config (rule DP104,
+    `dorpatch_tpu.analysis`), never from a hard-coded `PRNGKey(<int>)` that
+    forks the run's seed universe. `fold` derives an independent stream per
+    caller site (`jax.random.fold_in`)."""
+    key = jax.random.PRNGKey(_GLOBAL_SEED)
+    return jax.random.fold_in(key, fold) if fold else key
 
 
 def select_device(device: str = "0") -> Optional[jax.Device]:

@@ -4,6 +4,11 @@
 # behind any other live JAX process); tests run on an 8-device virtual CPU
 # mesh regardless (tests/conftest.py).
 cd "$(dirname "$0")"
+# Gate 1: the JAX-aware static-analysis rules (DP101-DP106) over the package
+# and tools — pure ast/tokenize logic, never initializes a jax backend,
+# fails on any finding.
+python -m dorpatch_tpu.analysis dorpatch_tpu tools || exit $?
+echo "static analysis: OK"
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@" \
   || exit $?
 # Smoke: the offline telemetry report CLI must render the checked-in fixture

@@ -1,0 +1,15 @@
+"""DP101 negative: every legal shape the old tokenize guard allowed."""
+
+import sys
+
+from dorpatch_tpu import observe
+
+# print( in a comment is fine
+S = "print(also fine in a string)"
+log = print  # referencing the callable is fine
+
+
+def report(x):
+    observe.log(f"loss: {x}")
+    sys.stdout.write("raw\n")
+    return x

@@ -1,0 +1,6 @@
+"""DP101 positive: bare print in (logical) package code."""
+
+
+def report(x):
+    print("loss:", x)  # <- DP101 (line 5)
+    return x

@@ -1,0 +1,21 @@
+"""DP102 negatives: static uses inside jit, host syncs outside any trace."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    b = int(x.shape[0])      # static under trace: fine
+    n = float(len(x.shape))  # static: fine
+    k = int(3)               # constant: fine
+    return x * b * n * k
+
+
+def host_driver(x):
+    # not a jit context: host syncs are the whole point here
+    y = jax.device_get(x)
+    z = float(np.asarray(y).mean())
+    jnp.asarray(x).block_until_ready()
+    return z + x.mean().item()

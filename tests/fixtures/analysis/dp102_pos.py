@@ -1,0 +1,35 @@
+"""DP102 positives: host syncs inside jit / loop-body contexts."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    v = x.mean().item()          # <- DP102: .item() (line 12)
+    h = np.asarray(x)            # <- DP102: np.asarray (line 13)
+    s = float(x.sum())           # <- DP102: float() on traced (line 14)
+    return v + h.sum() + s
+
+
+@partial(jax.jit, static_argnums=0)
+def step2(n, x):
+    return jax.device_get(x) * n  # <- DP102: device_get (line 19)
+
+
+def outer(xs):
+    def body(carry, x):
+        x.block_until_ready()    # <- DP102: sync in scan body (line 24)
+        return carry, x
+
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def fori(x):
+    def body_fun(i, acc):
+        return acc + int(x[i])   # <- DP102: int() on traced (line 32)
+
+    return jax.lax.fori_loop(0, 3, body_fun, jnp.float32(0))

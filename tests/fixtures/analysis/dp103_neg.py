@@ -1,0 +1,27 @@
+"""DP103 negatives: split-before-reuse discipline, rebinding, fold_in."""
+
+import jax
+from jax import random as jr
+
+
+def disciplined(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.uniform(k1, (4,))
+    b = jax.random.normal(k2, (4,))
+    return a + b
+
+
+def rebind(key):
+    x = jr.uniform(key, (2,))
+    key = jr.fold_in(key, 1)       # fold_in derives; not a consumer
+    key, sub = jr.split(key)
+    y = jr.uniform(sub, (2,))
+    return x + y
+
+
+def loop_carry(key, n):
+    total = 0.0
+    for i in range(n):
+        key, sub = jax.random.split(key)
+        total = total + jax.random.uniform(sub)
+    return total

@@ -1,0 +1,18 @@
+"""DP103 positives: key fed to two consumers with no split between."""
+
+import jax
+
+
+def double_use(key):
+    a = jax.random.uniform(key, (4,))
+    b = jax.random.normal(key, (4,))   # <- DP103 (line 8)
+    return a + b
+
+
+def reuse_after_branchless_use(key, flag):
+    if flag:
+        x = jax.random.uniform(key, (2,))
+    else:
+        x = jax.random.normal(key, (2,))  # separate paths: both fine
+    y = jax.random.bernoulli(key)         # <- DP103 (line 17): used on every path
+    return x, y

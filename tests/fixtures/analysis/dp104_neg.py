@@ -1,0 +1,13 @@
+"""DP104 negative: seeds flowing from config/args, not literals."""
+
+import jax
+
+from dorpatch_tpu import utils
+
+
+def init_state(seed):
+    return jax.random.PRNGKey(seed)
+
+
+def fallback_key():
+    return utils.global_key()

@@ -1,0 +1,7 @@
+"""DP104 positive: hard-coded PRNGKey literal outside utils.py/tests."""
+
+import jax
+
+
+def init_state():
+    return jax.random.PRNGKey(0)   # <- DP104 (line 7)

@@ -1,0 +1,28 @@
+"""DP105 negatives: every wrapped shape used in the real tree."""
+
+from functools import partial
+
+import jax
+
+from dorpatch_tpu import observe
+
+# direct wrap of the jit call
+step = observe.timed_first_call(jax.jit(lambda x: x * 2), "step",
+                                recompile_budget=1)
+
+
+@partial(jax.jit, static_argnums=())
+def run_block(state):
+    return state
+
+
+# wrap-by-name after a decorated def (the attack.py idiom)
+run_block = observe.timed_first_call(run_block, "block")
+
+
+@jax.jit
+def eval_step(x):
+    return x + 1
+
+
+eval_step = observe.timed_first_call(eval_step, "eval", recompile_budget=2)

@@ -1,0 +1,21 @@
+"""DP105 positives: jit entry points invisible to the telemetry layer."""
+
+from functools import partial
+
+import jax
+
+step = jax.jit(lambda x: x * 2)       # <- DP105 (line 7): bare assignment
+
+
+@jax.jit
+def decorated(x):                     # <- DP105 (decorator line 10)
+    return x + 1
+
+
+@partial(jax.jit, static_argnums=0)
+def decorated_partial(n, x):          # <- DP105 (decorator line 15)
+    return x * n
+
+
+def immediate(model, key, dummy):
+    return jax.jit(model.init)(key, dummy)   # <- DP105 (line 21)

@@ -1,0 +1,16 @@
+"""DP106 negatives: used imports, __all__ exports, `as`-re-exports."""
+
+from __future__ import annotations
+
+import json
+import os.path
+from typing import List
+from typing import Optional as Optional  # explicit re-export: exempt
+
+from dorpatch_tpu.analysis.engine import Finding  # exported via __all__
+
+__all__ = ["Finding", "dumps_path"]
+
+
+def dumps_path(paths: List[str]) -> str:
+    return json.dumps([os.path.basename(p) for p in paths])

@@ -1,0 +1,7 @@
+"""DP106 positives: imports nothing ever touches."""
+
+import json                      # <- DP106 (line 3)
+import os.path                   # <- DP106 (line 4): binds `os`, unused
+from typing import List, Optional  # <- DP106 x2 (line 5)
+
+VALUE = 1

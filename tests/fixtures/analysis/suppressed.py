@@ -1,0 +1,25 @@
+"""Every violation here carries a suppression — the file must lint clean."""
+
+import json  # noqa
+import os    # noqa: DP106 — kept for interactive debugging
+import sys   # noqa: F401 (flake8-alias for DP106)
+
+import jax
+
+
+def report(x):
+    print("loss:", x)  # noqa: DP101 — fixture demonstrates suppression
+    return x
+
+
+def seeded():
+    return jax.random.PRNGKey(0)  # noqa: DP104 — fixture fixed seed
+
+
+def double(key):
+    a = jax.random.uniform(key, (2,))
+    b = jax.random.normal(key, (2,))  # noqa: DP103 — deliberate correlation
+    return a + b
+
+
+step = jax.jit(lambda x: x)  # noqa: DP105 — fixture, telemetry not wanted

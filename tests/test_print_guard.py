@@ -1,52 +1,38 @@
 """Lint guard: no bare `print(` calls in `dorpatch_tpu/` outside `observe/`.
 
 Multi-process output must stay attributable — anonymous prints from N SPMD
-processes interleave uselessly. Everything routes through `observe.log()`
-(process-index + elapsed-time prefix); `observe/` itself implements that
-sink and the report CLI's stdout, so it is the one allowed exception.
+processes interleave uselessly; everything routes through `observe.log()`.
 
-Token-based (not regex) so comments/docstrings mentioning print( and
-`log=print`-style references don't false-positive: only a NAME token
-`print` immediately followed by `(` and not preceded by `.` counts.
+Since PR 2 the check IS rule DP101 of the analysis engine
+(`dorpatch_tpu.analysis.rules_output.BarePrintRule`); this file is a thin
+wrapper kept at its historical path so the invariant stays visible as its
+own test. The engine's AST pass preserves the old tokenize pass's
+semantics: comments, strings, `log = print` references and method calls
+named print don't count — only a real `print(...)` call expression does.
 """
 
-import io
 import pathlib
-import tokenize
+
+from dorpatch_tpu.analysis import analyze_file, analyze_paths
 
 PKG = pathlib.Path(__file__).resolve().parents[1] / "dorpatch_tpu"
 
 
-def bare_print_calls(path: pathlib.Path):
-    toks = list(tokenize.tokenize(io.BytesIO(path.read_bytes()).readline))
-    lines = []
-    for i, t in enumerate(toks):
-        if t.type != tokenize.NAME or t.string != "print":
-            continue
-        nxt = toks[i + 1] if i + 1 < len(toks) else None
-        prev = toks[i - 1] if i > 0 else None
-        if nxt is not None and nxt.type == tokenize.OP and nxt.string == "(" \
-                and not (prev is not None and prev.type == tokenize.OP
-                         and prev.string == "."):
-            lines.append(t.start[0])
-    return lines
+def bare_print_findings(path, logical_path=None):
+    return analyze_file(path, logical_path=logical_path, select=["DP101"])
 
 
 def test_no_bare_print_outside_observe():
-    offenders = {}
-    for path in sorted(PKG.rglob("*.py")):
-        if "observe" in path.relative_to(PKG).parts:
-            continue
-        lines = bare_print_calls(path)
-        if lines:
-            offenders[str(path.relative_to(PKG))] = lines
+    offenders = [f.render()
+                 for f in analyze_paths([PKG], select=["DP101"])]
     assert not offenders, (
         "bare print( calls found — route them through observe.log() so "
         f"multi-process output stays attributable: {offenders}")
 
 
 def test_guard_detects_prints(tmp_path):
-    """The guard itself must actually catch a bare print (and only that)."""
+    """The rule must catch a bare print (and only that) — the old tokenize
+    guard's self-test, now through the engine."""
     p = tmp_path / "x.py"
     p.write_text(
         "# print( in a comment is fine\n"
@@ -56,4 +42,5 @@ def test_guard_detects_prints(tmp_path):
         "sys.stdout.write('x')\n"
         "print('caught')\n"
     )
-    assert bare_print_calls(p) == [6]
+    found = bare_print_findings(p, logical_path="dorpatch_tpu/x.py")
+    assert [f.line for f in found] == [6]

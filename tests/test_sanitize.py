@@ -1,0 +1,196 @@
+"""Runtime sanitizer wing (`analysis/sanitize.py`): the recompile-budget
+watchdog trips on shape-unstable jits and stays quiet on the real attack
+step; log_compiles routes into observe events; flags restore on exit."""
+
+import json
+import os
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu import observe
+from dorpatch_tpu.analysis.sanitize import (
+    RecompileBudgetExceeded,
+    RecompileWatchdog,
+    Sanitizer,
+)
+from dorpatch_tpu.attack import DorPatch
+from dorpatch_tpu.config import AttackConfig
+
+
+def _tiny_attack(cfg, **kw):
+    def apply_fn(params, x):
+        s = x.mean(axis=(1, 2))  # [B,3]
+        return jnp.stack(
+            [s[:, 0], s[:, 1], s[:, 2], s.sum(-1) / 3.0], axis=-1) * 10
+
+    kw.setdefault("remat", False)
+    return DorPatch(apply_fn, None, 4, cfg, **kw)
+
+
+def test_watchdog_trips_on_shape_unstable_jit():
+    f = observe.timed_first_call(jax.jit(lambda x: x * 2), "unstable",
+                                 recompile_budget=1)
+    with Sanitizer(debug_nans=False, log_compiles=False):
+        f(jnp.ones((4,)))            # first trace: within budget
+        f(jnp.ones((4,)))            # same bucket: fine
+        with pytest.raises(RecompileBudgetExceeded):
+            f(jnp.ones((5,)))        # new shape bucket: over budget
+
+
+def test_watchdog_counts_buckets_not_calls():
+    f = observe.timed_first_call(jax.jit(lambda x: x + 1), "stable",
+                                 recompile_budget=2)
+    with Sanitizer(debug_nans=False, log_compiles=False):
+        for _ in range(5):
+            f(jnp.ones((3,)))
+        f(jnp.ones((4,)))            # second bucket: exactly at budget
+    assert int(f(jnp.ones((3,)))[0]) == 2
+
+
+def test_watchdog_quiet_on_real_attack_step():
+    """The DorPatch blocks are recompile-stable by design (one trace per
+    (stage, n_steps) program at fixed batch): a full tiny generate under the
+    armed watchdog at budget 1 must not trip. debug_nans stays on — the
+    attack's carry math (inf loss_best sentinels included) must be NaN-free."""
+    cfg = AttackConfig(sampling_size=4, max_iterations=4, sweep_interval=2,
+                       switch_iteration=2, dropout=1, dropout_sizes=(0.25,),
+                       basic_unit=4, patch_budget=0.15)
+    atk = _tiny_attack(cfg, recompile_budget=1)
+    x = jax.random.uniform(jax.random.PRNGKey(2), (1, 16, 16, 3)) * 0.2
+    with Sanitizer(log_compiles=False):
+        res = atk.generate(x, key=jax.random.PRNGKey(3))
+    assert res.adv_mask.shape == (1, 16, 16, 1)
+
+
+def test_watchdog_skips_unjitted_callables():
+    f = observe.timed_first_call(lambda x: x, "plain", recompile_budget=1)
+    with Sanitizer(debug_nans=False, log_compiles=False):
+        assert f(1) == 1 and f(2) == 2  # no _cache_size: never trips
+
+
+def test_retrace_within_budget_is_event_not_error(tmp_path):
+    elog = observe.EventLog(str(tmp_path / "events.jsonl"))
+    f = observe.timed_first_call(jax.jit(lambda x: x * 3), "bucketed",
+                                 recompile_budget=4)
+    with observe.active(elog), Sanitizer(debug_nans=False,
+                                         log_compiles=False):
+        f(jnp.ones((2,)))
+        f(jnp.ones((3,)))
+    elog.close()
+    recs = [json.loads(l) for l in open(tmp_path / "events.jsonl")]
+    retraces = [r for r in recs if r.get("name") == "sanitize.retrace"]
+    assert len(retraces) == 1
+    assert retraces[0]["traces"] == 2 and retraces[0]["budget"] == 4
+
+
+def test_log_compiles_routed_into_events(tmp_path):
+    elog = observe.EventLog(str(tmp_path / "events.jsonl"))
+    with observe.active(elog), Sanitizer(debug_nans=False,
+                                         recompile_budgets=False):
+        jax.jit(lambda x: x - 7)(jnp.ones((2,)))
+    elog.close()
+    recs = [json.loads(l) for l in open(tmp_path / "events.jsonl")]
+    compiles = [r for r in recs if r.get("name") == "jax.log_compiles"]
+    assert compiles, recs
+    assert all(r["message"].startswith("Compiling") for r in compiles)
+    # armed/disarmed is visible in the stream
+    assert any(r.get("name") == "sanitize.enabled" for r in recs)
+
+
+def test_debug_nans_raises_at_producing_op():
+    with Sanitizer(log_compiles=False, recompile_budgets=False):
+        with pytest.raises(FloatingPointError):
+            jax.block_until_ready(
+                jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0)))
+
+
+def test_sanitizer_restores_global_state():
+    prev_nans = bool(jax.config.jax_debug_nans)
+    prev_logs = bool(jax.config.jax_log_compiles)
+    with Sanitizer():
+        assert jax.config.jax_debug_nans
+        assert jax.config.jax_log_compiles
+        assert observe.recompile_guard() is not None
+    assert bool(jax.config.jax_debug_nans) == prev_nans
+    assert bool(jax.config.jax_log_compiles) == prev_logs
+    assert observe.recompile_guard() is None
+
+
+def test_budget_declaration_is_inert_without_sanitizer():
+    f = observe.timed_first_call(jax.jit(lambda x: x * 2), "inert",
+                                 recompile_budget=1)
+    f(jnp.ones((4,)))
+    f(jnp.ones((5,)))  # over budget, but no guard armed: no error
+    assert observe.recompile_guard() is None
+
+
+def test_watchdog_unit_without_jax_objects():
+    class FakeJit:
+        def __init__(self):
+            self.n = 0
+
+        def _cache_size(self):
+            return self.n
+
+    wd = RecompileWatchdog()
+    fake = FakeJit()
+    fake.n = 1
+    wd.after_call("e", fake, 2)
+    fake.n = 2
+    wd.after_call("e", fake, 2)
+    fake.n = 3
+    with pytest.raises(RecompileBudgetExceeded):
+        wd.after_call("e", fake, 2)
+
+
+@pytest.mark.slow
+def test_pipeline_e2e_under_sanitize(tmp_path):
+    """The full tiny synthetic experiment runs clean under --sanitize: no
+    NaNs, every jitted entry point within its declared recompile budget,
+    and the sanitizer's events land in the run's events.jsonl."""
+    from dorpatch_tpu.config import (AttackConfig, DefenseConfig,
+                                     ExperimentConfig)
+    from dorpatch_tpu.pipeline import run_experiment
+
+    cfg = ExperimentConfig(
+        dataset="cifar10",
+        base_arch="resnet18",
+        batch_size=2,
+        num_batches=1,
+        synthetic_data=True,
+        sanitize=True,
+        img_size=32,
+        results_root=str(tmp_path / "results"),
+        attack=AttackConfig(
+            sampling_size=6, max_iterations=4, sweep_interval=2,
+            switch_iteration=2, dropout=1, basic_unit=4, patch_budget=0.15,
+        ),
+        defense=DefenseConfig(ratios=(0.06,), chunk_size=18),
+    )
+    m = run_experiment(cfg, verbose=False)
+    assert "report" in m
+    # sanitizer state unwound
+    assert not jax.config.jax_debug_nans
+    assert observe.recompile_guard() is None
+    # its events are in the telemetry stream
+    results = next(p for p, _, fs in os.walk(tmp_path)
+                   if "events.jsonl" in fs)
+    recs = [json.loads(l) for l in open(os.path.join(results,
+                                                     "events.jsonl"))]
+    names = {r.get("name") for r in recs if r["kind"] == "event"}
+    assert "sanitize.enabled" in names
+    assert "jax.log_compiles" in names
+
+
+def test_pipeline_flag_plumbed():
+    """--sanitize reaches ExperimentConfig (the pipeline enters Sanitizer
+    when set)."""
+    from dorpatch_tpu.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args(["--sanitize", "--synthetic"])
+    assert config_from_args(args).sanitize is True
+    args = build_parser().parse_args(["--synthetic"])
+    assert config_from_args(args).sanitize is False

@@ -70,11 +70,12 @@ def main():
         if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
         victim.params)
 
-    key = jax.random.PRNGKey(0)
-    xb = jax.random.uniform(key, (n, img, img, 3), jnp.bfloat16)
+    key = jax.random.PRNGKey(0)  # noqa: DP104 — standalone profiling harness, fixed seed is deliberate
+    key, k_xb = jax.random.split(key)
+    xb = jax.random.uniform(k_xb, (n, img, img, 3), jnp.bfloat16)
 
     if only is None or "fwd" in only:
-        @jax.jit
+        @jax.jit  # noqa: DP105 — harness times compile itself
         def fwd_scan(x0):
             def body(x, _):
                 logits = victim.apply(params16, x)
@@ -85,7 +86,7 @@ def main():
                    n * RN50_FWD_GFLOPS * 1e9)
 
     if only is None or "bwd" in only:
-        @jax.jit
+        @jax.jit  # noqa: DP105 — harness times compile itself
         def fwdbwd_scan(x0):
             def body(x, _):
                 g = jax.grad(
@@ -100,12 +101,13 @@ def main():
     cfg = AttackConfig(sampling_size=s, compute_dtype="bfloat16")
     universe = jnp.asarray(
         masks_lib.dropout_universe(img, cfg.dropout, cfg.dropout_sizes))
-    x = jax.random.uniform(key, (b, img, img, 3), jnp.float32)
+    key, k_x = jax.random.split(key)
+    x = jax.random.uniform(k_x, (b, img, img, 3), jnp.float32)
 
     if only is None or "mf" in only:
         from dorpatch_tpu import ops
 
-        @jax.jit
+        @jax.jit  # noqa: DP105 — harness times compile itself
         def mf_scan(x0):
             def body(xc, i):
                 rects = jax.lax.dynamic_slice_in_dim(universe, 0, s, 0)
