@@ -39,7 +39,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m dorpatch_tpu.analysis",
         description="JAX-aware static analysis for the dorpatch-tpu tree "
-                    "(rules DP101-DP106; see --list-rules)")
+                    "(rules DP101-DP107; see --list-rules)")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories to lint (default: "
                         f"{' '.join(DEFAULT_PATHS)})")

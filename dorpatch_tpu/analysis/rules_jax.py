@@ -1,5 +1,6 @@
 """JAX-aware rules: DP102 host-sync-in-jit, DP103 PRNG key reuse,
-DP104 literal PRNGKey seeds, DP105 unwrapped jax.jit call sites.
+DP104 literal PRNGKey seeds, DP105 unwrapped jax.jit call sites,
+DP107 host syncs in serve/ outside the marshalling point.
 
 What these protect (PAPER.md "EOT inner loop", ROADMAP north star):
 
@@ -17,6 +18,11 @@ What these protect (PAPER.md "EOT inner loop", ROADMAP north star):
   wrapped in `observe.timed_first_call` so its trace+compile wall time lands
   in events.jsonl as a `compile` record (and, under `--sanitize`, so the
   recompile-budget watchdog can see its cache growth).
+- DP107: the serving worker loop must stay sync-free — a `.item()` /
+  `jax.device_get` / `block_until_ready` anywhere in `serve/` other than
+  the designated `marshal_response` function stalls the dispatch pipeline
+  per batch and silently serializes the micro-batching hot path. (DP102
+  can't see these: serving code is eager host code, not jitted bodies.)
 """
 
 from __future__ import annotations
@@ -440,4 +446,72 @@ class UnwrappedJitRule(Rule):
         parent = parents.get(id(node))
         if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
             return parent
+        return None
+
+
+@register
+class ServeHostSyncRule(Rule):
+    id = "DP107"
+    name = "serve-host-sync"
+    description = ("blocking host sync (.item()/device_get/"
+                   "block_until_ready) inside serve/ outside the designated "
+                   "response-marshalling function")
+
+    #: The ONE function in serve/ allowed to synchronize device results to
+    #: the host (`serve.service.marshal_response`). Everything else in the
+    #: worker-loop path must stay dispatch-only, or every batch stalls the
+    #: pipeline mid-flight and the micro-batcher serializes.
+    MARSHAL_FUNCTION = "marshal_response"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_package() or "serve" not in ctx.scoped_parts:
+            return
+        # module-level statements sync too (import-time device pulls)
+        for node in self._own_nodes(ctx.tree):
+            msg = self._offense(ctx, node)
+            if msg is not None:
+                yield self.finding(ctx, node, msg)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name == self.MARSHAL_FUNCTION:
+                continue
+            for node in self._own_nodes(fn):
+                msg = self._offense(ctx, node)
+                if msg is not None:
+                    yield self.finding(ctx, node, msg)
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+        """Walk a function body WITHOUT descending into nested defs (each
+        nested def is visited — and possibly exempted — on its own)."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _offense(self, ctx: FileContext, node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        tail = (f" — only {self.MARSHAL_FUNCTION}() may sync to the host "
+                "in serve/")
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "item":
+                return (".item() blocks the serving worker on a device "
+                        "round-trip" + tail)
+            if node.func.attr == "block_until_ready":
+                return "block_until_ready() stalls the dispatch pipeline" \
+                    + tail
+        target = ctx.resolve(node.func)
+        if target in ("jax.device_get", "jax.block_until_ready"):
+            return f"{target}() blocks the serving worker" + tail
+        if target in ("numpy.asarray", "numpy.array"):
+            # the codebase's canonical sync spelling: blocking when fed a
+            # device array. Host-data parsing that needs it carries a
+            # reasoned `# noqa: DP107`.
+            return (f"{target}() materializes a device array on the host "
+                    "when fed one" + tail)
         return None

@@ -8,7 +8,8 @@ from __future__ import annotations
 
 import argparse
 
-from dorpatch_tpu.config import AttackConfig, DefenseConfig, ExperimentConfig
+from dorpatch_tpu.config import (AttackConfig, DefenseConfig,
+                                 ExperimentConfig, ServeConfig)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -108,6 +109,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="PatchCleanser mask-set patch count for the defense "
                         "bank (the reference always certifies n_patch=1; "
                         "2 = pair/triple mask sets, PatchCleanser.py:24-37)")
+    # serving (`python -m dorpatch_tpu.serve` reuses this parser)
+    p.add_argument("--serve-port", type=int, default=8700,
+                   help="HTTP front-end port for the certified-inference "
+                        "service (0 = ephemeral)")
+    p.add_argument("--serve-max-batch", type=int, default=8,
+                   help="largest serving micro-batch; shape buckets are "
+                        "data.batch_buckets(max_batch), e.g. 8 -> 1/8")
+    p.add_argument("--serve-queue-depth", type=int, default=64,
+                   help="backpressure bound: requests past this queue depth "
+                        "are rejected with a typed Overloaded response")
+    p.add_argument("--serve-deadline-ms", type=float, default=2000.0,
+                   help="default per-request latency budget; the batcher "
+                        "flushes a partial batch once half of it is spent")
     p.add_argument("--remat-policy", default="full",
                    choices=["full", "conv", "dots"],
                    help="what an active remat recomputes: full = the whole "
@@ -170,6 +184,10 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         attack=attack,
         defense=DefenseConfig(use_pallas=args.use_pallas,
                               n_patch=args.defense_n_patch),
+        serve=ServeConfig(port=args.serve_port,
+                          max_batch=args.serve_max_batch,
+                          max_queue_depth=args.serve_queue_depth,
+                          deadline_ms=args.serve_deadline_ms),
     )
 
 

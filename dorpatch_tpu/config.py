@@ -99,6 +99,32 @@ class DefenseConfig:
     use_pallas: str = "auto"        # fused mask-fill kernel: auto|on|off|interpret
 
 
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Online certified-inference service (`dorpatch_tpu/serve/`).
+
+    The micro-batcher admits requests into a bounded queue and flushes a
+    batch when a full bucket's worth is pending (size trigger) or when the
+    oldest request has spent `flush_fraction` of its latency budget
+    (deadline trigger). Batches pad up to fixed bucket sizes
+    (`data.batch_buckets(max_batch)`, e.g. 1/8/32) so the jitted
+    defense/certify programs compile once per bucket at startup warmup and
+    never retrace under live traffic — enforced via the PR 2 recompile
+    watchdog budgets."""
+
+    max_batch: int = 8              # largest micro-batch (top bucket size)
+    bucket_sizes: Tuple[int, ...] = ()  # () = derive data.batch_buckets(max_batch)
+    max_queue_depth: int = 64       # backpressure bound: submissions past
+                                    # this many queued requests get a typed
+                                    # Overloaded reject, never unbounded queueing
+    deadline_ms: float = 2000.0     # default per-request latency budget
+    flush_fraction: float = 0.5     # flush when this fraction of the oldest
+                                    # queued request's budget is spent
+    host: str = "127.0.0.1"
+    port: int = 8700                # HTTP front-end bind port (0 = ephemeral)
+    warmup: bool = True             # compile every bucket's programs at start
+
+
 def config_to_dict(cfg: "ExperimentConfig") -> dict:
     """JSON-safe nested dict of the full experiment config (reproducibility
     record written beside summary.json by the pipelines)."""
@@ -124,8 +150,10 @@ def config_from_dict(d: dict) -> "ExperimentConfig":
     d = dict(d)
     attack = build(AttackConfig, d.pop("attack", {}))
     defense = build(DefenseConfig, d.pop("defense", {}))
+    serve = build(ServeConfig, d.pop("serve", {}))
     cfg = build(ExperimentConfig, d)
-    return dataclasses.replace(cfg, attack=attack, defense=defense)
+    return dataclasses.replace(cfg, attack=attack, defense=defense,
+                               serve=serve)
 
 
 def resolved_data_source(cfg: "ExperimentConfig") -> str:
@@ -202,6 +230,7 @@ class ExperimentConfig:
 
     attack: AttackConfig = dataclasses.field(default_factory=AttackConfig)
     defense: DefenseConfig = dataclasses.field(default_factory=DefenseConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
     @property
     def num_classes(self) -> int:

@@ -16,11 +16,41 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
 from dorpatch_tpu.config import NUM_CLASSES
+
+
+def batch_buckets(max_batch: int) -> Tuple[int, ...]:
+    """Fixed batch-size buckets up to (and including) `max_batch`:
+    1, 8, 32, 128, ... (x4 past 8), e.g. max_batch=32 -> (1, 8, 32).
+
+    Shared by the batching layers (`defense.PatchCleanser.robust_predict`,
+    `serve/`): a jitted program compiled per *bucket* instead of per exact
+    batch size compiles O(log B) times total and never retraces on the
+    ragged final batch a data stream or a correctness filter produces. The
+    cost is padded rows (wasted forwards) bounded by the bucket spacing —
+    at most 7/8 of a batch in the worst case, typically far less."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    out = {1, int(max_batch)}
+    b = 8
+    while b < max_batch:
+        out.add(b)
+        b *= 4
+    return tuple(sorted(out))
+
+
+def bucket_batch(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (the padded batch size a ragged batch rounds up
+    to). `n` must not exceed the largest bucket."""
+    for b in sorted(buckets):
+        if b >= n:
+            return int(b)
+    raise ValueError(f"batch of {n} exceeds the largest bucket "
+                     f"{max(buckets)} (buckets {tuple(sorted(buckets))})")
 
 
 def _resize_center_crop(img: "np.ndarray", size: int) -> np.ndarray:

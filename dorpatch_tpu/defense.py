@@ -29,12 +29,13 @@ Tie-breaking notes (documented deviations, metric-neutral):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, List, NamedTuple, Sequence
+from typing import Any, Callable, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dorpatch_tpu import data as data_lib
 from dorpatch_tpu import masks as masks_lib
 from dorpatch_tpu import observe
 from dorpatch_tpu import ops
@@ -278,17 +279,43 @@ class PatchCleanser:
             f"defense.predict.r{self.spec.patch_ratio}",
             recompile_budget=self.recompile_budget)
 
+    def predict_tables(self, params, imgs: jax.Array, num_classes: int):
+        """DEVICE-resident verdict tables `(pred [B], certified [B],
+        preds_1 [B,M], preds_2 [B,P])` — dispatch-only, no host sync.
+        The serving worker uses this to launch every certifier (and the
+        clean forward) before materializing ANY result, so the programs
+        overlap on device instead of serializing on per-radius transfers;
+        `robust_predict` is this plus host marshalling."""
+        return self._predict(params, imgs, num_classes)
+
     def robust_predict(
-        self, params, imgs: jax.Array, num_classes: int
+        self, params, imgs: jax.Array, num_classes: int,
+        bucket_sizes: Optional[Sequence[int]] = None,
     ) -> List[PatchCleanserRecord]:
         """Batched robust prediction + certification; returns one record per
         image (the reference's per-image `robust_predict(img, certify=True)`,
-        vmapped away)."""
-        pred, certified, p1, p2 = self._predict(params, imgs, num_classes)
+        vmapped away).
+
+        `bucket_sizes` (e.g. `data.batch_buckets(cfg.batch_size)`) rounds a
+        ragged batch up to the nearest fixed bucket before hitting the jitted
+        sweep, so the program compiles once per *bucket* instead of once per
+        exact batch size — the correctness filter and final data batches
+        otherwise force a fresh XLA compile for every distinct B. Padding
+        repeats the first image; every verdict is a pure per-row function of
+        the prediction tables, so padded rows cannot perturb real rows, and
+        they are sliced out of the returned records."""
+        n = int(imgs.shape[0])
+        if bucket_sizes is not None and n:
+            m = data_lib.bucket_batch(n, bucket_sizes)
+            if m > n:
+                fill = jnp.broadcast_to(imgs[:1], (m - n,) + imgs.shape[1:])
+                imgs = jnp.concatenate([imgs, fill], axis=0)
+        pred, certified, p1, p2 = self.predict_tables(params, imgs,
+                                                      num_classes)
         pred, certified, p1, p2 = map(np.asarray, (pred, certified, p1, p2))
         return [
             PatchCleanserRecord(int(pred[b]), bool(certified[b]), p1[b], p2[b])
-            for b in range(imgs.shape[0])
+            for b in range(n)
         ]
 
     def reset(self):

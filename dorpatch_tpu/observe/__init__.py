@@ -62,7 +62,11 @@ from dorpatch_tpu.observe.manifest import (  # noqa: F401
     run_manifest,
     write_run_manifest,
 )
-from dorpatch_tpu.observe.timing import StepTimer, trace  # noqa: F401
+from dorpatch_tpu.observe.timing import (  # noqa: F401
+    StepTimer,
+    nearest_rank_percentile,
+    trace,
+)
 
 __all__ = [
     "METRIC_NAMES",
@@ -80,6 +84,7 @@ __all__ = [
     "heartbeat_gaps",
     "jax_environment",
     "log",
+    "nearest_rank_percentile",
     "new_run_id",
     "process_index",
     "read_heartbeats",

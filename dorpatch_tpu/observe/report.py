@@ -22,7 +22,7 @@ from typing import Dict, List, Optional
 
 from dorpatch_tpu.observe.heartbeat import summarize_heartbeats
 from dorpatch_tpu.observe.manifest import MANIFEST_NAME
-from dorpatch_tpu.observe.timing import StepTimer
+from dorpatch_tpu.observe.timing import StepTimer, nearest_rank_percentile
 
 
 def _read_jsonl(path: str) -> List[dict]:
@@ -166,6 +166,8 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
                         flops_per_step=float(tele["flops_per_step"]),
                         peak_flops=float(tele["peak_flops"]))
 
+    serve = _summarize_serve(ev)
+
     metrics_by_attempt: Dict[str, int] = {}
     for m in metrics:
         rid = m.get("run_id", "(unstamped)")
@@ -203,11 +205,57 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
             if certify_seconds and certify_images else 0.0,
         },
         "mfu": mfu,
+        "serve": serve,
         "peak_device_bytes": peak_mem or None,
         "heartbeats": summarize_heartbeats(result_dir,
                                            stall_factor=stall_factor),
         "metrics_records": {"total": len(metrics),
                             "by_attempt": metrics_by_attempt},
+    }
+
+
+def _percentile_ms(sorted_s: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile of an ascending seconds list, in ms."""
+    v = nearest_rank_percentile(sorted_s, q)
+    return None if v is None else round(v * 1e3, 3)
+
+
+def _summarize_serve(ev: List[dict]) -> Optional[dict]:
+    """The serving section: request latency percentiles, throughput,
+    batch occupancy, and reject rate — from the `serve.request` events and
+    `serve.batch` spans the service emits. None when the results dir holds
+    no serving telemetry (batch runs keep their report unchanged)."""
+    reqs = [r for r in ev
+            if r.get("kind") == "event" and r.get("name") == "serve.request"]
+    batches = [r for r in ev
+               if r.get("kind") == "span" and r.get("name") == "serve.batch"]
+    if not reqs and not batches:
+        return None
+    by_status: Dict[str, int] = {}
+    for r in reqs:
+        st = str(r.get("status", "?"))
+        by_status[st] = by_status.get(st, 0) + 1
+    ok_lat = sorted(float(r.get("latency_s", 0.0)) for r in reqs
+                    if r.get("status") == "ok")
+    total = sum(by_status.values())
+    rejected = by_status.get("overloaded", 0)
+    ts = [float(r["ts"]) for r in reqs if "ts" in r]
+    wall = (max(ts) - min(ts)) if len(ts) >= 2 else 0.0
+    images = sum(int(b.get("images", 0)) for b in batches)
+    slots = sum(int(b.get("bucket", 0)) for b in batches)
+    return {
+        "requests": total,
+        "by_status": dict(sorted(by_status.items())),
+        "latency_ms": {"count": len(ok_lat),
+                       "p50": _percentile_ms(ok_lat, 0.50),
+                       "p95": _percentile_ms(ok_lat, 0.95),
+                       "p99": _percentile_ms(ok_lat, 0.99)},
+        "throughput_rps": round(len(ok_lat) / wall, 3) if wall else None,
+        "batches": len(batches),
+        "batch_seconds": round(sum(float(b.get("dur_s", 0.0))
+                                   for b in batches), 3),
+        "occupancy": round(images / slots, 4) if slots else None,
+        "reject_rate": round(rejected / total, 4) if total else 0.0,
     }
 
 
@@ -276,6 +324,22 @@ def format_report(s: dict) -> str:
         add("  mfu: n/a (no FLOPs accounting in run.json:telemetry)")
     if s["peak_device_bytes"]:
         add(f"  peak device memory: {_fmt_bytes(s['peak_device_bytes'])}")
+
+    sv = s.get("serve")
+    if sv:
+        add("-- serve --")
+        statuses = ", ".join(f"{k}: {v}" for k, v in sv["by_status"].items())
+        add(f"  requests: {sv['requests']} ({statuses})")
+        lat = sv["latency_ms"]
+        if lat["count"]:
+            add(f"  latency: p50 {lat['p50']} ms, p95 {lat['p95']} ms, "
+                f"p99 {lat['p99']} ms ({lat['count']} ok)")
+        if sv["throughput_rps"] is not None:
+            add(f"  throughput: {sv['throughput_rps']} req/sec")
+        occ = (f"{100.0 * sv['occupancy']:.1f}%"
+               if sv["occupancy"] is not None else "n/a")
+        add(f"  batches: {sv['batches']} in {sv['batch_seconds']}s, "
+            f"occupancy {occ}, reject rate {100.0 * sv['reject_rate']:.1f}%")
 
     add("-- heartbeats --")
     if not s["heartbeats"]:

@@ -1,11 +1,28 @@
-"""Wall-clock block timing (with MFU accounting) and jax.profiler traces."""
+"""Wall-clock block timing (with MFU accounting), jax.profiler traces, and
+the shared latency-percentile formula."""
 
 from __future__ import annotations
 
 import contextlib
-from typing import Optional
+import math
+from typing import Optional, Sequence
 
 import time
+
+
+def nearest_rank_percentile(sorted_vals: Sequence[float],
+                            q: float) -> Optional[float]:
+    """Nearest-rank percentile over an ASCENDING sequence (None when empty).
+
+    The ONE percentile formula for serving latency: the service's live
+    `/stats`, the offline report's serve section, and `tools/loadgen.py`
+    all route through it, so their p50/p95/p99 rows can never disagree on
+    the same samples (same contract as `StepTimer.summary` for MFU)."""
+    if not sorted_vals:
+        return None
+    idx = max(0, min(len(sorted_vals) - 1,
+                     math.ceil(q * len(sorted_vals)) - 1))
+    return float(sorted_vals[idx])
 
 
 class StepTimer:

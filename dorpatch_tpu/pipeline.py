@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dorpatch_tpu import losses, metrics, observe, parallel, utils
+from dorpatch_tpu import data, losses, metrics, observe, parallel, utils
 from dorpatch_tpu.artifacts import ArtifactStore, results_path, write_config_record
 from dorpatch_tpu.attack import DorPatch
 from dorpatch_tpu.config import ExperimentConfig, resolved_data_source
@@ -151,6 +151,12 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             # sizes (1..batch_size) are the only legitimate shape buckets.
             # Enforced by the recompile watchdog under --sanitize.
             budget = int(cfg.batch_size)
+            # certification runs bucketed (single-chip path): ragged batches
+            # round up to data.batch_buckets sizes, so the 666-mask sweep
+            # compiles once per bucket, not once per surviving batch size.
+            # Meshed runs keep exact-batch sweeps: padding would re-lay-out
+            # the sharded input and defeat the place_batch contract.
+            cert_buckets = None
             mesh = None
             if cfg.mesh_data * cfg.mesh_mask > 1:
                 mesh = parallel.make_mesh(cfg.mesh_data, cfg.mesh_mask)
@@ -161,9 +167,10 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                     victim.apply, victim.params, victim.num_classes,
                     cfg.attack, mesh, recompile_budget=budget)
             else:
+                cert_buckets = data.batch_buckets(cfg.batch_size)
                 defenses = build_defenses(victim.apply, cfg.img_size,
                                           cfg.defense,
-                                          recompile_budget=budget)
+                                          recompile_budget=len(cert_buckets))
                 attack = DorPatch(victim.apply, victim.params,
                                   victim.num_classes, cfg.attack,
                                   recompile_budget=budget)
@@ -319,7 +326,8 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                                       images=int(x.shape[0])):
                         per_defense = [
                             d.robust_predict(victim.params, adv_x,
-                                             victim.num_classes)
+                                             victim.num_classes,
+                                             bucket_sizes=cert_buckets)
                             for d in defenses
                         ]
                     # records_batch[img][defense], the reference's nesting

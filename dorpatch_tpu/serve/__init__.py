@@ -1,0 +1,45 @@
+"""Online certified-inference service (the ROADMAP's "serves heavy traffic"
+leg): micro-batched PatchCleanser serving with a shape-bucketed
+zero-recompile hot path, bounded-queue backpressure, an `http.server` JSON
+front-end, and full events.jsonl telemetry.
+
+    service = CertifiedInferenceService.from_config(cfg)
+    with service, HttpFrontend(service, port=cfg.serve.port):
+        ...                      # or: python -m dorpatch_tpu.serve
+
+    service.predict(image)       # direct Python client (no sockets)
+
+See `service.py` for the request lifecycle, `batcher.py` for the
+size-or-deadline flush rules, `types.py` for the typed responses.
+"""
+
+from dorpatch_tpu.serve.batcher import MicroBatcher, PendingRequest  # noqa: F401
+from dorpatch_tpu.serve.http import HttpFrontend  # noqa: F401
+from dorpatch_tpu.serve.service import (  # noqa: F401
+    CertifiedInferenceService,
+    marshal_response,
+    resolved_bucket_sizes,
+)
+from dorpatch_tpu.serve.types import (  # noqa: F401
+    HTTP_STATUS,
+    DeadlineExceeded,
+    Overloaded,
+    PredictResult,
+    RadiusVerdict,
+    ServeError,
+)
+
+__all__ = [
+    "HTTP_STATUS",
+    "CertifiedInferenceService",
+    "DeadlineExceeded",
+    "HttpFrontend",
+    "MicroBatcher",
+    "Overloaded",
+    "PendingRequest",
+    "PredictResult",
+    "RadiusVerdict",
+    "ServeError",
+    "marshal_response",
+    "resolved_bucket_sizes",
+]

@@ -1,0 +1,41 @@
+"""`python -m dorpatch_tpu.serve` — stand up the certified-inference
+service over the configured victim and serve HTTP until interrupted.
+
+Reuses the experiment CLI surface (`dorpatch_tpu.cli.build_parser`): model/
+dataset/defense flags select what is served, the `--serve-*` group sizes
+the micro-batcher and front-end. Telemetry lands in
+`<results_root>/serve/` (run.json + events.jsonl); render it with
+`python -m dorpatch_tpu.observe.report <results_root>/serve`.
+"""
+
+from __future__ import annotations
+
+import time
+
+from dorpatch_tpu import observe
+from dorpatch_tpu.cli import build_parser, config_from_args
+from dorpatch_tpu.serve.http import HttpFrontend
+from dorpatch_tpu.serve.service import CertifiedInferenceService
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    service = CertifiedInferenceService.from_config(cfg)
+    with service:
+        observe.log(
+            f"serve: warm ({service.trace_counts()}) — "
+            f"buckets {list(service.bucket_sizes)}, "
+            f"queue depth {service.batcher.max_queue_depth}, "
+            f"deadline {cfg.serve.deadline_ms:g} ms")
+        with HttpFrontend(service, cfg.serve.host, cfg.serve.port):
+            try:
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                observe.log("serve: shutting down")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

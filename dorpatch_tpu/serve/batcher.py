@@ -1,0 +1,140 @@
+"""Micro-batcher: bounded request queue with size-or-deadline flush.
+
+Pure host-side queue logic (no jax): single-image requests accumulate in a
+FIFO guarded by one condition variable; the worker blocks in `next_batch`
+until either
+
+- **size trigger** — a full top bucket's worth of requests is pending
+  (`max(bucket_sizes)`), or
+- **deadline trigger** — the OLDEST pending request has spent
+  `flush_fraction` of its latency budget (default: half). Flushing at the
+  half-budget point leaves the other half for the batched forward + certify
+  sweep itself, so a lone request still answers inside its deadline instead
+  of waiting forever for company.
+
+Backpressure is a typed reject at submit time: past `max_queue_depth`
+pending requests, `submit` refuses (the service maps that onto an
+`Overloaded` response) — the queue never grows unboundedly and latency
+stays bounded by design.
+
+The batcher never pads — it hands the worker at most `max(bucket_sizes)`
+real requests; rounding the batch up to a shape bucket is the worker's job
+(`service._run_batch`), because padding is a device-layout concern, not a
+queueing concern.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional, Sequence
+
+
+class PendingRequest:
+    """One queued request: the image, its absolute deadline (perf-clock
+    seconds), and the event/result slot the submitting thread waits on."""
+
+    __slots__ = ("image", "enqueued", "deadline", "done", "result")
+
+    def __init__(self, image, enqueued: float, deadline: float):
+        self.image = image
+        self.enqueued = enqueued
+        self.deadline = deadline
+        self.done = threading.Event()
+        self.result = None
+
+    def budget_s(self) -> float:
+        return self.deadline - self.enqueued
+
+    def resolve(self, result) -> None:
+        self.result = result
+        self.done.set()
+
+
+class MicroBatcher:
+    """Bounded FIFO with size-or-deadline flush (see module docstring)."""
+
+    def __init__(self, bucket_sizes: Sequence[int], max_queue_depth: int,
+                 flush_fraction: float = 0.5, clock=time.perf_counter):
+        if not bucket_sizes:
+            raise ValueError("bucket_sizes must be non-empty")
+        if not 0.0 < flush_fraction <= 1.0:
+            raise ValueError(f"flush_fraction must be in (0, 1], got "
+                             f"{flush_fraction}")
+        self.bucket_sizes = tuple(sorted(int(b) for b in bucket_sizes))
+        self.max_batch = self.bucket_sizes[-1]
+        self.max_queue_depth = int(max_queue_depth)
+        self.flush_fraction = float(flush_fraction)
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._pending = collections.deque()
+        self._closed = False
+
+    # ---------------- producer side ----------------
+
+    def submit(self, req: PendingRequest) -> bool:
+        """Enqueue; False = backpressure reject (queue at max_queue_depth)
+        or batcher closed. Nothing is ever queued on a False return."""
+        with self._cond:
+            if self._closed or len(self._pending) >= self.max_queue_depth:
+                return False
+            self._pending.append(req)
+            self._cond.notify_all()
+            return True
+
+    def qsize(self) -> int:
+        with self._cond:
+            return len(self._pending)
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    def close(self) -> None:
+        """Stop admitting; the worker drains what is queued, then
+        `next_batch` returns None."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # ---------------- consumer side ----------------
+
+    def _flush_at(self, req: PendingRequest) -> float:
+        """Perf-clock instant at which `req` forces a flush."""
+        return req.enqueued + self.flush_fraction * req.budget_s()
+
+    def _next_flush(self) -> float:
+        """Earliest flush instant over EVERY pending request — not just the
+        head's: a short-deadline request queued behind a long-deadline one
+        must still flush inside its own budget (head-of-line starvation)."""
+        return min(self._flush_at(r) for r in self._pending)
+
+    def next_batch(self) -> Optional[List[PendingRequest]]:
+        """Block until a flush triggers; returns up to `max_batch` requests
+        in arrival order, or None when closed and fully drained."""
+        with self._cond:
+            while True:
+                if self._pending:
+                    now = self._clock()
+                    if (len(self._pending) >= self.max_batch
+                            or self._closed
+                            or now >= self._next_flush()):
+                        return [self._pending.popleft()
+                                for _ in range(min(len(self._pending),
+                                                   self.max_batch))]
+                    # sleep until the earliest flush instant; a submit that
+                    # fills the bucket (or carries a tighter deadline)
+                    # notifies us and we recompute. The wait is clamped:
+                    # a pathological deadline (inf/NaN slipping past
+                    # validation) must degrade to a slow poll, never an
+                    # OverflowError or an unbounded sleep in the worker
+                    wait_s = self._next_flush() - now
+                    if not (wait_s > 0.0):  # also catches NaN
+                        wait_s = 0.05
+                    self._cond.wait(min(wait_s, 60.0))
+                elif self._closed:
+                    return None
+                else:
+                    self._cond.wait()

@@ -1,0 +1,111 @@
+"""Thin HTTP front-end on stdlib `http.server` (JSON in/out).
+
+Endpoints:
+
+- `POST /predict` — body `{"image": [[[...]]], "deadline_ms": 250}` (HWC
+  float nested lists in [0, 1]; `deadline_ms` optional). Answers the typed
+  response as JSON with the status-code mapping in `types.HTTP_STATUS`
+  (200 ok / 503 overloaded / 504 deadline_exceeded / 400 error).
+- `GET /healthz` — liveness + warmup state.
+- `GET /stats`   — the service's live counters, latency percentiles,
+  queue depth, and per-program trace counts.
+
+One handler thread per connection (`ThreadingHTTPServer`); every thread
+funnels into the same `service.predict`, so the micro-batcher — not the
+socket layer — decides batching and backpressure. Tests and the load
+generator can skip sockets entirely and call `service.predict` directly.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from dorpatch_tpu import observe
+from dorpatch_tpu.serve.types import HTTP_STATUS
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set per-server via the factory in HttpFrontend
+    service = None
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server contract
+        if self.path == "/healthz":
+            h = self.service.healthz()
+            self._send_json(200 if h["status"] == "ok" else 503, h)
+        elif self.path == "/stats":
+            self._send_json(200, self.service.stats())
+        else:
+            self._send_json(404, {"status": "error",
+                                  "reason": f"no route {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server contract
+        if self.path != "/predict":
+            self._send_json(404, {"status": "error",
+                                  "reason": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            image = payload["image"]
+            deadline_ms = payload.get("deadline_ms")
+            if deadline_ms is not None \
+                    and not isinstance(deadline_ms, (int, float)):
+                raise ValueError("deadline_ms must be a number")
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_json(400, {"status": "error",
+                                  "reason": f"bad request body: {e!r}"})
+            return
+        resp = self.service.predict(image, deadline_ms=deadline_ms)
+        self._send_json(HTTP_STATUS.get(resp.status, 500), resp.to_dict())
+
+    def log_message(self, fmt: str, *args) -> None:
+        # route through observe (rule DP101: no bare prints); request-level
+        # telemetry already lands in events.jsonl, so keep this quiet
+        pass
+
+
+class HttpFrontend:
+    """Owns the listening socket + serve_forever thread; `port` reports the
+    bound port (pass 0 to bind an ephemeral one for tests)."""
+
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"service": service})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HttpFrontend":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="serve-http", daemon=True)
+        self._thread.start()
+        observe.log(f"serve: http front-end on {self.host}:{self.port} "
+                    f"(/predict /healthz /stats)")
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def __enter__(self) -> "HttpFrontend":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

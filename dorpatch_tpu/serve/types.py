@@ -1,0 +1,110 @@
+"""Typed request/response messages of the certified-inference service.
+
+Every answer the service gives is one of these frozen dataclasses — the
+Python client returns them directly, the HTTP front-end maps them onto
+status codes + JSON via `to_dict`. A rejected request is DATA
+(`Overloaded`), not an exception: backpressure is part of the serving
+contract (bounded queue, typed reject) rather than an error path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class RadiusVerdict:
+    """One PatchCleanser certifier's answer (one mask family / patch ratio)."""
+
+    ratio: float
+    prediction: int
+    certified: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictResult:
+    """Successful certified prediction for one image.
+
+    `prediction`/`certified` are the headline answer: the smallest-radius
+    defense's double-masking prediction, certified iff EVERY radius in the
+    bank certifies (the conservative join the pipeline's certified-accuracy
+    metric uses). `verdicts` carries the full per-radius breakdown,
+    `clean_prediction` the undefended model argmax."""
+
+    status = "ok"
+    prediction: int
+    certified: bool
+    clean_prediction: int
+    verdicts: Tuple[RadiusVerdict, ...]
+    latency_ms: float
+    bucket: int          # padded batch size the request rode in
+    batch_images: int    # real (unpadded) images in that batch
+
+    def to_dict(self) -> dict:
+        return {
+            "status": self.status,
+            "prediction": self.prediction,
+            "certified": self.certified,
+            "clean_prediction": self.clean_prediction,
+            "verdicts": [dataclasses.asdict(v) for v in self.verdicts],
+            "latency_ms": round(self.latency_ms, 3),
+            "bucket": self.bucket,
+            "batch_images": self.batch_images,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class Overloaded:
+    """Typed backpressure reject: the bounded queue is full. Clients should
+    back off and retry; nothing was enqueued."""
+
+    status = "overloaded"
+    queue_depth: int
+    limit: int
+
+    def to_dict(self) -> dict:
+        return {"status": self.status, "queue_depth": self.queue_depth,
+                "limit": self.limit}
+
+
+@dataclasses.dataclass(frozen=True)
+class DeadlineExceeded:
+    """The request's latency budget elapsed before its batch finished; the
+    (stale) result is withheld so callers never act on an expired answer."""
+
+    status = "deadline_exceeded"
+    latency_ms: float
+    deadline_ms: float
+
+    def to_dict(self) -> dict:
+        return {"status": self.status,
+                "latency_ms": round(self.latency_ms, 3),
+                "deadline_ms": round(self.deadline_ms, 3)}
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeError:
+    """Malformed input (`status="error"` -> 400) or a server-side failure
+    (`status="internal_error"` -> 500, so clients and load balancers retry
+    and alert on the right side of the contract)."""
+
+    reason: str
+    latency_ms: Optional[float] = None
+    status: str = "error"
+
+    def to_dict(self) -> dict:
+        out = {"status": self.status, "reason": self.reason}
+        if self.latency_ms is not None:
+            out["latency_ms"] = round(self.latency_ms, 3)
+        return out
+
+
+#: HTTP status code per response type (the front-end's mapping).
+HTTP_STATUS = {
+    "ok": 200,
+    "overloaded": 503,
+    "deadline_exceeded": 504,
+    "error": 400,
+    "internal_error": 500,
+}

@@ -4,7 +4,7 @@
 # behind any other live JAX process); tests run on an 8-device virtual CPU
 # mesh regardless (tests/conftest.py).
 cd "$(dirname "$0")"
-# Gate 1: the JAX-aware static-analysis rules (DP101-DP106) over the package
+# Gate 1: the JAX-aware static-analysis rules (DP101-DP107) over the package
 # and tools — pure ast/tokenize logic, never initializes a jax backend,
 # fails on any finding.
 python -m dorpatch_tpu.analysis dorpatch_tpu tools || exit $?
@@ -18,3 +18,25 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python -m dorpatch_tpu.observe.report tests/fixtures/report_run \
   > /dev/null || exit $?
 echo "report CLI smoke: OK"
+# Smoke: the serving layer end-to-end — stand up the in-process
+# certified-inference service (stub victim), fire the load generator at it,
+# require every request to succeed with ZERO recompiles after warmup, and
+# require the report CLI to render the serve section (latency percentiles,
+# occupancy, reject rate) from the resulting events.jsonl.
+SERVE_SMOKE=$(mktemp -d)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/loadgen.py --requests 16 --stub-victim \
+  --results-dir "$SERVE_SMOKE" --out "$SERVE_SMOKE/loadgen.json" \
+  > /dev/null || exit $?
+grep -q '"ok": 16' "$SERVE_SMOKE/loadgen.json" \
+  || { echo "serve smoke: not all 16 requests ok:"; \
+       cat "$SERVE_SMOKE/loadgen.json"; exit 1; }
+grep -q '"zero_recompile": true' "$SERVE_SMOKE/loadgen.json" \
+  || { echo "serve smoke: hot path retraced:"; \
+       cat "$SERVE_SMOKE/loadgen.json"; exit 1; }
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python -m dorpatch_tpu.observe.report "$SERVE_SMOKE" \
+  | grep -q -e "-- serve --" \
+  || { echo "serve smoke: report missing serve section"; exit 1; }
+rm -rf "$SERVE_SMOKE"
+echo "serve loadgen smoke: OK"

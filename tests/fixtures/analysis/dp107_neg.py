@@ -1,0 +1,28 @@
+"""DP107 negatives: syncs live only in the designated marshal_response
+function; the worker stays dispatch-only (linted as
+dorpatch_tpu/serve/worker.py)."""
+
+import jax
+import numpy as np
+
+
+def marshal_response(reqs, logits):
+    # the ONE sanctioned host-sync point: materialize + deadline-check here
+    table = jax.device_get(logits)
+    return [(r, int(t.argmax()), float(t.max().item())) for r, t in
+            zip(reqs, table)]
+
+
+def run_batch(programs, params, reqs, x):
+    # dispatch-only: H2D transfer and jit calls never block on results
+    logits = programs.clean(params, jax.device_put(np.stack(x)))
+    return marshal_response(reqs, logits)
+
+
+def worker_loop(batcher, programs, params):
+    while True:
+        batch = batcher.next_batch()
+        if batch is None:
+            return
+        for resp in run_batch(programs, params, batch, [r.image for r in batch]):
+            resp[0].resolve(resp)

@@ -1,0 +1,26 @@
+"""DP107 positives: host syncs in a serve/ worker loop outside the
+designated marshalling function (linted as dorpatch_tpu/serve/worker.py)."""
+
+import jax
+import numpy as np
+
+WARM_TABLE = np.asarray(jax.device_put([1.0]))  # <- DP107 (line 7):
+#    module-level np.asarray sync
+
+
+def run_batch(programs, params, x):
+    logits = programs.clean(params, x)
+    logits.block_until_ready()            # <- DP107: sync in worker
+    table = jax.device_get(logits)        # <- DP107: device_get
+    table2 = np.asarray(logits)           # <- DP107: np.asarray sync
+    return table, table2
+
+
+def worker_loop(batcher, programs, params):
+    while True:
+        batch = batcher.next_batch()
+        if batch is None:
+            return
+        preds = run_batch(programs, params, batch)
+        score = preds[0].mean().item()    # <- DP107: .item()
+        batcher.report(score)

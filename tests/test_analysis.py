@@ -25,15 +25,17 @@ from dorpatch_tpu.analysis.cli import main as cli_main
 REPO = pathlib.Path(__file__).resolve().parents[1]
 FIXTURES = REPO / "tests" / "fixtures" / "analysis"
 
-RULE_IDS = ("DP101", "DP102", "DP103", "DP104", "DP105", "DP106")
+RULE_IDS = ("DP101", "DP102", "DP103", "DP104", "DP105", "DP106", "DP107")
 
 
 def run_fixture(name: str, rule_id: str):
     """Lint one fixture as if it lived at dorpatch_tpu/<name>, keeping only
     the rule under test (fixtures legitimately trip other rules: e.g. the
-    DP102 positives use undecorated prints of their own)."""
-    findings = analyze_file(FIXTURES / name,
-                            logical_path=f"dorpatch_tpu/{name}")
+    DP102 positives use undecorated prints of their own). DP107 fixtures
+    lint as serve/ files (the rule is scoped to that subpackage)."""
+    logical = (f"dorpatch_tpu/serve/{name}" if name.startswith("dp107")
+               else f"dorpatch_tpu/{name}")
+    findings = analyze_file(FIXTURES / name, logical_path=logical)
     return [f for f in findings if f.rule_id == rule_id]
 
 
@@ -91,6 +93,41 @@ def test_dp101_exempt_outside_package():
 def test_dp104_exemptions(logical):
     findings = analyze_file(FIXTURES / "dp104_pos.py", logical_path=logical)
     assert not [f for f in findings if f.rule_id == "DP104"]
+
+
+def test_dp107_catches_each_sync_kind():
+    found = run_fixture("dp107_pos.py", "DP107")
+    msgs = " | ".join(f.message for f in found)
+    for kind in (".item()", "block_until_ready", "jax.device_get",
+                 "numpy.asarray"):
+        assert kind in msgs, f"missing {kind}: {msgs}"
+    # module-level statements are scanned too (line 7's np.asarray)
+    assert any(f.line == 7 for f in found), [f.line for f in found]
+
+
+@pytest.mark.parametrize("logical", [
+    "dorpatch_tpu/pipeline.py",      # outside serve/: DP107 does not apply
+    "tools/serve/loadgen.py",        # tools tree is never package scope
+    "tests/serve/test_worker.py",    # test tree exempt
+])
+def test_dp107_scoped_to_serve_subpackage(logical):
+    findings = analyze_file(FIXTURES / "dp107_pos.py", logical_path=logical)
+    assert not [f for f in findings if f.rule_id == "DP107"]
+
+
+def test_dp107_nested_def_inside_marshal_is_exempt():
+    """Each def is judged by its own name: marshal_response's own body
+    (incl. comprehensions) is exempt, but a helper nested inside it does
+    NOT inherit the exemption."""
+    src = ("import jax\n"
+           "def marshal_response(logits):\n"
+           "    vals = [v.item() for v in jax.device_get(logits)]\n"
+           "    def helper(x):\n"
+           "        return x.item()\n"
+           "    return vals, helper\n")
+    found = analyze_source(src, logical_path="dorpatch_tpu/serve/service.py",
+                           select=["DP107"])
+    assert len(found) == 1 and found[0].line == 5
 
 
 # ---------- suppression syntax ----------

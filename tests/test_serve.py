@@ -1,0 +1,404 @@
+"""Serving subsystem tests: shared batch-size bucketing, micro-batcher
+flush/backpressure semantics, typed responses, the HTTP front-end, and the
+tier-1 acceptance e2e — after startup warmup, 50+ mixed-size requests
+complete with ZERO recompiles (recompile-watchdog-armed, trace counts
+checked) and verdicts identical to a direct `defense.robust_predict` call
+on the same images, with the report CLI rendering the serve section."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu import data as data_lib
+from dorpatch_tpu import masks as masks_lib
+from dorpatch_tpu.config import DefenseConfig, ServeConfig
+from dorpatch_tpu.defense import PatchCleanser
+from dorpatch_tpu.observe import report
+from dorpatch_tpu.serve import (
+    CertifiedInferenceService,
+    DeadlineExceeded,
+    HttpFrontend,
+    MicroBatcher,
+    Overloaded,
+    PendingRequest,
+    PredictResult,
+    ServeError,
+)
+
+IMG = 32
+N_CLASSES = 5
+
+
+def stub_apply(params, x):
+    """Weightless, occlusion-sensitive classifier: class = brightness
+    bucket, so masking (gray fill) genuinely moves predictions."""
+    s = x.mean(axis=(1, 2, 3))
+    return jax.nn.one_hot((s * 7).astype(jnp.int32) % N_CLASSES, N_CLASSES)
+
+
+def make_images(n, seed=0):
+    rng = np.random.default_rng(seed)
+    base = rng.uniform(0.0, 1.0, (n, 4, 4, 3)).astype(np.float32)
+    return np.kron(base, np.ones((1, IMG // 4, IMG // 4, 1), np.float32))
+
+
+def make_service(tmp_path=None, **serve_kw):
+    kw = dict(max_batch=4, bucket_sizes=(1, 2, 4), deadline_ms=4000.0,
+              max_queue_depth=64)
+    kw.update(serve_kw)
+    return CertifiedInferenceService(
+        stub_apply, None, num_classes=N_CLASSES, img_size=IMG,
+        serve_cfg=ServeConfig(**kw),
+        defense_cfg=DefenseConfig(ratios=(0.1,), chunk_size=64),
+        result_dir=str(tmp_path / "serve") if tmp_path is not None else None)
+
+
+# ---------- shared bucketing helpers (data.py) ----------
+
+def test_batch_buckets_ladder():
+    assert data_lib.batch_buckets(1) == (1,)
+    assert data_lib.batch_buckets(4) == (1, 4)
+    assert data_lib.batch_buckets(8) == (1, 8)
+    assert data_lib.batch_buckets(32) == (1, 8, 32)
+    assert data_lib.batch_buckets(100) == (1, 8, 32, 100)
+    with pytest.raises(ValueError):
+        data_lib.batch_buckets(0)
+
+
+def test_bucket_batch_rounds_up():
+    buckets = (1, 8, 32)
+    assert data_lib.bucket_batch(1, buckets) == 1
+    assert data_lib.bucket_batch(2, buckets) == 8
+    assert data_lib.bucket_batch(8, buckets) == 8
+    assert data_lib.bucket_batch(9, buckets) == 32
+    with pytest.raises(ValueError):
+        data_lib.bucket_batch(33, buckets)
+
+
+# ---------- defense.robust_predict bucketing (satellite) ----------
+
+@pytest.fixture(scope="module")
+def stub_certifier():
+    return PatchCleanser(stub_apply, masks_lib.geometry(IMG, 0.1))
+
+
+def test_bucketed_robust_predict_padding_isolation(stub_certifier):
+    """Padded rows must never perturb real rows' verdicts, and ragged
+    batches inside one bucket must share ONE compiled program."""
+    pc = stub_certifier
+    imgs = jnp.asarray(make_images(3, seed=3))
+    want = pc.robust_predict(None, imgs, N_CLASSES)          # exact batch 3
+    got = pc.robust_predict(None, imgs, N_CLASSES, bucket_sizes=(1, 4))
+    assert len(got) == 3
+    for w, g in zip(want, got):
+        assert g.prediction == w.prediction
+        assert g.certification == w.certification
+        np.testing.assert_array_equal(g.preds_1, w.preds_1)
+        np.testing.assert_array_equal(g.preds_2, w.preds_2)
+
+
+def test_bucketed_robust_predict_shares_traces():
+    pc = PatchCleanser(stub_apply, masks_lib.geometry(IMG, 0.1))
+    for b in (2, 3, 4):  # all round up to the same bucket of 4
+        recs = pc.robust_predict(None, jnp.asarray(make_images(b, seed=b)),
+                                 N_CLASSES, bucket_sizes=(4, 8))
+        assert len(recs) == b
+    assert int(pc._predict._cache_size()) == 1
+
+
+# ---------- micro-batcher flush semantics ----------
+
+def _req(budget_s=10.0, image=None):
+    now = time.perf_counter()
+    return PendingRequest(image if image is not None else make_images(1)[0],
+                          enqueued=now, deadline=now + budget_s)
+
+
+def test_size_triggered_flush():
+    b = MicroBatcher((1, 2, 4), max_queue_depth=16)
+    for _ in range(5):
+        assert b.submit(_req(budget_s=30.0))
+    t0 = time.perf_counter()
+    batch = b.next_batch()
+    # a full top bucket flushes immediately — long before the 15 s
+    # half-budget deadline trigger — and leaves the 5th request queued
+    assert time.perf_counter() - t0 < 5.0
+    assert len(batch) == 4
+    assert b.qsize() == 1
+
+
+def test_deadline_triggered_flush():
+    b = MicroBatcher((1, 2, 4), max_queue_depth=16)
+    assert b.submit(_req(budget_s=0.6))
+    t0 = time.perf_counter()
+    batch = b.next_batch()
+    elapsed = time.perf_counter() - t0
+    # a lone request flushes once HALF its budget is spent: well before
+    # the deadline itself, but not immediately
+    assert len(batch) == 1
+    assert 0.1 <= elapsed < 0.55, elapsed
+
+
+def test_deadline_flush_not_head_of_line_blocked():
+    """A short-deadline request queued behind a long-deadline head must
+    flush within ITS OWN budget — the flush instant is the min over every
+    pending request, not the head's (head-of-line starvation regression)."""
+    b = MicroBatcher((1, 2, 8), max_queue_depth=16)
+    assert b.submit(_req(budget_s=60.0))   # head alone would flush at +30s
+    assert b.submit(_req(budget_s=0.4))    # tail forces a flush at +0.2s
+    t0 = time.perf_counter()
+    batch = b.next_batch()
+    elapsed = time.perf_counter() - t0
+    assert len(batch) == 2
+    assert elapsed < 1.0, elapsed
+
+
+def test_backpressure_reject_and_close_drain():
+    b = MicroBatcher((1, 2), max_queue_depth=2)
+    assert b.submit(_req())
+    assert b.submit(_req())
+    assert not b.submit(_req())          # typed reject, nothing queued
+    assert b.qsize() == 2
+    b.close()
+    assert not b.submit(_req())          # closed: no admission
+    assert len(b.next_batch()) == 2      # drain flushes immediately
+    assert b.next_batch() is None        # drained + closed -> worker exits
+
+
+# ---------- service-level typed responses ----------
+
+def test_service_rejects_bad_shape_and_overload(tmp_path):
+    svc = make_service(max_batch=4, bucket_sizes=(4,), max_queue_depth=3,
+                       deadline_ms=30000.0, flush_fraction=1.0)
+    with svc:
+        bad = svc.predict(np.zeros((8, 8, 3), np.float32))
+        assert isinstance(bad, ServeError) and "shape" in bad.reason
+        ragged = svc.predict([[1.0, [2.0]]])  # does not even parse
+        assert isinstance(ragged, ServeError) and ragged.status == "error"
+        # fill the bounded queue below the flush threshold (bucket of 4
+        # never fills, budgets are long), then overflow it
+        for _ in range(3):
+            assert svc.batcher.submit(_req(budget_s=30.0))
+        resp = svc.predict(make_images(1)[0])
+        assert isinstance(resp, Overloaded)
+        assert resp.limit == 3 and resp.queue_depth == 3
+        assert resp.to_dict()["status"] == "overloaded"
+    # stop() drains: the queued requests were answered, not dropped
+    s = svc.stats()
+    assert s["rejected"] == 1 and s["errors"] == 2  # bad shape + ragged
+
+
+def test_nonfinite_deadline_rejected_service_survives():
+    """Infinity/NaN are legal JSON floats; they must come back as typed
+    errors and never reach the batcher's flush arithmetic (a single bad
+    request previously wedged or killed the worker thread)."""
+    svc = make_service()
+    with svc:
+        for bad in (float("inf"), float("nan"), -5.0, 0.0):
+            r = svc.predict(make_images(1)[0], deadline_ms=bad)
+            assert isinstance(r, ServeError), bad
+            assert "deadline_ms" in r.reason
+        ok = svc.predict(make_images(1)[0], deadline_ms=30000.0)
+        assert isinstance(ok, PredictResult)
+    assert svc.stats()["errors"] == 4 and svc.stats()["completed"] == 1
+
+
+def test_service_restarts_after_stop():
+    """stop() closes the batcher; a subsequent start() must serve again
+    instead of rejecting everything as Overloaded (regression)."""
+    svc = make_service()
+    img = make_images(1)[0]
+    for _ in range(2):
+        with svc:
+            r = svc.predict(img, deadline_ms=30000.0)
+            assert isinstance(r, PredictResult)
+    assert svc.stats()["completed"] == 2
+
+
+def test_start_failure_unwinds_global_state(tmp_path, monkeypatch):
+    """A failed start (warmup OOM / budget trip) must restore the active
+    EventLog, the run span, and the recompile guard — the next run in this
+    process must not inherit serving globals."""
+    from dorpatch_tpu import observe
+
+    def boom(self):
+        raise RuntimeError("warmup boom")
+
+    monkeypatch.setattr(CertifiedInferenceService, "warmup", boom)
+    svc = make_service(tmp_path)
+    with pytest.raises(RuntimeError, match="warmup boom"):
+        svc.start()
+    assert observe.active_event_log() is None
+    assert observe.recompile_guard() is None
+    svc.stop()  # idempotent no-op after a failed start
+
+
+def test_service_deadline_exceeded(tmp_path):
+    svc = make_service(deadline_ms=0.001)
+    with svc:
+        resp = svc.predict(make_images(1)[0])
+    assert isinstance(resp, DeadlineExceeded)
+    assert resp.to_dict()["status"] == "deadline_exceeded"
+    assert svc.stats()["deadline_exceeded"] == 1
+
+
+# ---------- tier-1 acceptance e2e ----------
+
+def _fire(svc, images, concurrency):
+    """Closed-loop burst: `concurrency` in-flight callers over `images`."""
+    results = [None] * len(images)
+    nxt = {"i": 0}
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                i = nxt["i"]
+                if i >= len(images):
+                    return
+                nxt["i"] = i + 1
+            results[i] = svc.predict(images[i])
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def test_serve_e2e_zero_recompile_correct_verdicts_reported(tmp_path, capsys):
+    """ISSUE acceptance: warmup, then 50+ mixed-size requests -> all ok,
+    ZERO recompiles (watchdog-armed + trace-count-verified), verdicts equal
+    a direct defense.robust_predict on the same images, and the report CLI
+    renders the serve section with latency percentiles + reject rate."""
+    images = make_images(52, seed=7)
+    svc = make_service(tmp_path)
+    with svc:
+        warm = svc.trace_counts()
+        # one program per shape bucket, compiled at warmup
+        assert set(warm.values()) == {len(svc.bucket_sizes)}
+
+        results = []
+        # mixed batch sizes: lone requests (bucket 1), small bursts
+        # (padded buckets), saturating bursts (full buckets)
+        results += _fire(svc, images[:2], concurrency=1)
+        results += _fire(svc, images[2:12], concurrency=3)
+        results += _fire(svc, images[12:], concurrency=8)
+
+        after = svc.trace_counts()
+        stats = svc.stats()
+    assert all(isinstance(r, PredictResult) for r in results)
+    assert after == warm, f"hot path retraced: {warm} -> {after}"
+
+    # verdict parity vs a direct certifier on the same images (fresh
+    # programs, so this cannot mask a serving-side retrace)
+    ref = PatchCleanser(stub_apply, masks_lib.geometry(IMG, 0.1))
+    want = ref.robust_predict(None, jnp.asarray(images), N_CLASSES)
+    clean_want = np.asarray(
+        jnp.argmax(stub_apply(None, jnp.asarray(images)), -1))
+    for i, (r, w) in enumerate(zip(results, want)):
+        assert r.prediction == w.prediction, f"request {i}"
+        assert r.certified == w.certification, f"request {i}"
+        assert r.clean_prediction == int(clean_want[i]), f"request {i}"
+        assert r.verdicts[0].ratio == 0.1
+        assert r.latency_ms >= 0.0 and r.bucket in svc.bucket_sizes
+
+    assert stats["completed"] == 52 and stats["rejected"] == 0
+    assert stats["latency_ms"]["p50"] is not None
+    assert 0.0 < stats["occupancy"] <= 1.0
+
+    # the results dir carries the standard telemetry contract
+    rd = str(tmp_path / "serve")
+    manifest = json.load(open(f"{rd}/run.json"))
+    assert manifest["service"] == "serve" and manifest["run_id"]
+
+    assert report.main([rd]) == 0
+    out = capsys.readouterr().out
+    assert "-- serve --" in out
+    assert "p50" in out and "p95" in out
+    assert "reject rate 0.0%" in out
+    assert "run span never closed" not in out  # clean shutdown
+
+    assert report.main([rd, "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["serve"]["requests"] == 52
+    assert s["serve"]["by_status"] == {"ok": 52}
+    assert s["serve"]["latency_ms"]["count"] == 52
+    assert s["serve"]["reject_rate"] == 0.0
+    assert s["serve"]["occupancy"] and 0.0 < s["serve"]["occupancy"] <= 1.0
+    assert s["serve"]["throughput_rps"] > 0
+
+
+# ---------- load generator (tools/loadgen.py) ----------
+
+def test_loadgen_inprocess_stub(tmp_path, capsys):
+    """The CI smoke's exact path: in-process stub service, BENCH-style JSON
+    line, zero-recompile contract reported, telemetry dir renders."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "loadgen", os.path.join(os.path.dirname(__file__), "..", "tools",
+                                "loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+
+    out = tmp_path / "loadgen.json"
+    rd = tmp_path / "serve"
+    rc = loadgen.main(["--requests", "12", "--stub-victim",
+                       "--results-dir", str(rd), "--out", str(out),
+                       "--concurrency", "3"])
+    assert rc == 0
+    rep = json.loads(out.read_text())
+    assert rep["metric"] == "serve_load" and rep["mode"] == "closed"
+    assert rep["by_status"] == {"ok": 12}
+    assert rep["zero_recompile"] is True
+    assert rep["latency_ms"]["p50"] is not None
+    capsys.readouterr()
+    assert report.main([str(rd)]) == 0
+    assert "-- serve --" in capsys.readouterr().out
+
+
+# ---------- HTTP front-end ----------
+
+def test_http_front_end(tmp_path):
+    svc = make_service()
+    with svc, HttpFrontend(svc, port=0) as fe:
+        base = f"http://127.0.0.1:{fe.port}"
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+            h = json.loads(r.read())
+        assert h["status"] == "ok" and h["warm"] is True
+
+        body = json.dumps({"image": make_images(1)[0].tolist()}).encode()
+        req = urllib.request.Request(
+            f"{base}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+            p = json.loads(r.read())
+        assert p["status"] == "ok"
+        assert isinstance(p["prediction"], int)
+        assert isinstance(p["certified"], bool)
+        assert p["verdicts"][0]["ratio"] == 0.1
+
+        with urllib.request.urlopen(f"{base}/stats", timeout=30) as r:
+            st = json.loads(r.read())
+        assert st["completed"] == 1 and st["trace_counts"]
+
+        # malformed body -> 400 with a typed error payload
+        bad = urllib.request.Request(
+            f"{base}/predict", data=b"not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=30)
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["status"] == "error"

@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Open/closed-loop load generator for the certified-inference service.
+
+Drives `dorpatch_tpu.serve` and prints ONE BENCH-style JSON line (stdout):
+throughput, latency percentiles, status mix, batch occupancy, and —
+in-process — whether the zero-recompile contract held (per-program trace
+counts identical before and after traffic).
+
+Modes:
+- **closed loop** (default): `--concurrency` workers each keep exactly one
+  request in flight — classic latency-vs-throughput operating point.
+- **open loop**: requests arrive at `--rate` per second regardless of
+  completions — the overload probe; expect typed `overloaded` rejects once
+  the arrival rate outruns the service, never unbounded queueing.
+
+Targets:
+- default: an IN-PROCESS service (no sockets), built over a stub victim
+  (`--stub-victim`, cheap brightness classifier — the CI smoke) or the
+  configured real model. `--results-dir` keeps its telemetry so
+  `python -m dorpatch_tpu.observe.report <dir>` renders the serve section.
+- `--url http://host:port`: an already-running HTTP front-end
+  (`python -m dorpatch_tpu.serve`); this process then never initializes an
+  accelerator backend (pure sockets + the host-only percentile helper).
+
+Examples:
+  python tools/loadgen.py --requests 16 --stub-victim --results-dir /tmp/s
+  python tools/loadgen.py --requests 200 --mode open --rate 100 \
+      --url http://127.0.0.1:8700
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_images(n: int, img_size: int, seed: int) -> np.ndarray:
+    """Deterministic smooth-ish random images, HWC float32 in [0, 1]."""
+    rng = np.random.default_rng(seed)
+    base = rng.uniform(0.0, 1.0, (n, 4, 4, 3)).astype(np.float32)
+    return np.clip(np.kron(base, np.ones((1, img_size // 4, img_size // 4, 1),
+                                         np.float32)), 0.0, 1.0)
+
+
+def _http_predict(url: str, image: np.ndarray, deadline_ms: float) -> dict:
+    import urllib.error
+    import urllib.request
+
+    body = json.dumps({"image": image.tolist(),
+                       "deadline_ms": deadline_ms}).encode("utf-8")
+    req = urllib.request.Request(
+        url.rstrip("/") + "/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=deadline_ms / 1e3 + 60) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:  # typed rejects ride error codes
+        try:
+            return json.loads(e.read())
+        except ValueError:
+            return {"status": "error", "reason": f"http {e.code}"}
+    except (urllib.error.URLError, OSError) as e:
+        return {"status": "error", "reason": repr(e)}
+
+
+def _build_inprocess_service(args):
+    """In-process target; imports jax lazily so --url runs stay host-only."""
+    from dorpatch_tpu.config import DefenseConfig, ExperimentConfig, ServeConfig
+
+    serve_cfg = ServeConfig(max_batch=args.max_batch,
+                            max_queue_depth=args.queue_depth,
+                            deadline_ms=args.deadline_ms)
+    defense_cfg = DefenseConfig(ratios=tuple(args.ratios))
+    from dorpatch_tpu.serve import CertifiedInferenceService
+
+    if args.stub_victim:
+        import jax
+        import jax.numpy as jnp
+
+        def apply_fn(params, x):
+            # brightness-bucket classifier: occlusion-sensitive, no weights
+            s = x.mean(axis=(1, 2, 3))
+            return jax.nn.one_hot((s * 7).astype(jnp.int32) % 5, 5)
+
+        return CertifiedInferenceService(
+            apply_fn, None, num_classes=5, img_size=args.img_size,
+            serve_cfg=serve_cfg, defense_cfg=defense_cfg,
+            result_dir=args.results_dir or None,
+            run_cfg=ExperimentConfig(dataset="cifar10", img_size=args.img_size,
+                                     serve=serve_cfg, defense=defense_cfg))
+    cfg = ExperimentConfig(dataset="cifar10", base_arch=args.arch,
+                           img_size=args.img_size, serve=serve_cfg,
+                           defense=defense_cfg, synthetic_data=True)
+    return CertifiedInferenceService.from_config(
+        cfg, result_dir=args.results_dir or None)
+
+
+def run_load(send, images: np.ndarray, args) -> dict:
+    """Fire the workload; returns per-request (status, latency_s) tuples
+    aggregated into the report dict."""
+    results = []
+    res_lock = threading.Lock()
+
+    def fire(i: int) -> None:
+        t0 = time.perf_counter()
+        resp = send(images[i % len(images)], args.deadline_ms)
+        dt = time.perf_counter() - t0
+        with res_lock:
+            results.append((resp.get("status", "error")
+                            if isinstance(resp, dict)
+                            else resp.status, dt))
+
+    t_start = time.perf_counter()
+    if args.mode == "closed":
+        nxt = {"i": 0}
+
+        def worker() -> None:
+            while True:
+                with res_lock:
+                    i = nxt["i"]
+                    if i >= args.requests:
+                        return
+                    nxt["i"] = i + 1
+                fire(i)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(args.concurrency)]
+        for t in threads:
+            t.start()
+    else:
+        # open loop: scheduled arrivals at --rate req/sec. Threads spawn
+        # LAZILY at each request's arrival instant (live thread count =
+        # in-flight requests, not --requests), so a big run doesn't burn
+        # a stack per future request or measure scheduler churn
+        threads = []
+        for i in range(args.requests):
+            delay = t_start + i / args.rate - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=fire, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+
+    by_status = {}
+    for status, _ in results:
+        by_status[status] = by_status.get(status, 0) + 1
+    ok = sorted(dt for status, dt in results if status == "ok")
+
+    def pct(q):
+        # the shared nearest-rank formula: this line, the service's /stats,
+        # and the report CLI must agree on the same samples
+        from dorpatch_tpu.observe import nearest_rank_percentile
+
+        v = nearest_rank_percentile(ok, q)
+        return None if v is None else round(v * 1e3, 3)
+
+    total = len(results)
+    return {
+        "metric": "serve_load",
+        "mode": args.mode,
+        "requests": total,
+        "wall_seconds": round(wall, 3),
+        "by_status": dict(sorted(by_status.items())),
+        "throughput_rps": round(by_status.get("ok", 0) / wall, 3)
+        if wall else 0.0,
+        "latency_ms": {"p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99),
+                       "count": len(ok)},
+        "reject_rate": round(by_status.get("overloaded", 0) / total, 4)
+        if total else 0.0,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="load generator for the certified-inference service")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--mode", choices=["closed", "open"], default="closed")
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="closed-loop in-flight requests")
+    p.add_argument("--rate", type=float, default=50.0,
+                   help="open-loop arrival rate (req/sec)")
+    p.add_argument("--deadline-ms", type=float, default=5000.0)
+    p.add_argument("--url", default="",
+                   help="target a running HTTP front-end instead of an "
+                        "in-process service")
+    p.add_argument("--stub-victim", action="store_true",
+                   help="serve a weightless brightness classifier (fast "
+                        "CI smoke) instead of a real model")
+    p.add_argument("--arch", default="resnet18")
+    p.add_argument("--img-size", type=int, default=32)
+    p.add_argument("--ratios", type=float, nargs="+", default=[0.1],
+                   help="defense bank patch ratios (in-process target)")
+    p.add_argument("--max-batch", type=int, default=4)
+    p.add_argument("--queue-depth", type=int, default=32)
+    p.add_argument("--results-dir", default="",
+                   help="keep the in-process service's telemetry here "
+                        "(run.json + events.jsonl for the report CLI)")
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--out", default="", help="also write the JSON here")
+    args = p.parse_args(argv)
+
+    images = make_images(min(args.requests, 64), args.img_size, args.seed)
+
+    if args.url:
+        report = run_load(
+            lambda img, dl: _http_predict(args.url, img, dl), images, args)
+        report["target"] = args.url
+    else:
+        service = _build_inprocess_service(args)
+        with service:
+            before = service.trace_counts()
+            report = run_load(
+                lambda img, dl: service.predict(img, deadline_ms=dl).to_dict(),
+                images, args)
+            after = service.trace_counts()
+            stats = service.stats()
+        report["target"] = "in-process"
+        report["occupancy"] = stats["occupancy"]
+        report["trace_counts"] = after
+        report["zero_recompile"] = before == after
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
