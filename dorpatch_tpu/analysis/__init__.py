@@ -1,23 +1,30 @@
-"""Static analysis + runtime sanitizers for the dorpatch-tpu framework.
+"""Static analysis + program auditing + runtime sanitizers.
 
-Two wings, one invariant set:
+Three wings, one invariant set:
 
-- **Static** (`engine.py`, `rules_output.py`, `rules_jax.py`, `cli.py`):
-  an AST rule engine with stable `DPxxx` IDs, `# noqa: DPxxx` suppressions,
-  and a CLI gate (`python -m dorpatch_tpu.analysis`, wired into
-  `run_tests.sh`). Catches what is provable from source: bare prints,
-  host syncs under trace, PRNG key reuse, literal seeds, unwrapped jits,
-  unused imports.
+- **AST** (`engine.py`, `rules_output.py`, `rules_jax.py`, `cli.py`):
+  rules DP101-DP107 with stable IDs, `# noqa: DPxxx` suppressions, a
+  mechanical DP106 fixer (`fix.py`, `--fix`), and a CLI gate
+  (`python -m dorpatch_tpu.analysis`, wired into `run_tests.sh`). Catches
+  what is provable from source: bare prints, host syncs under trace, PRNG
+  key reuse, literal seeds, unwrapped jits, unused imports.
+- **Trace** (`entrypoints.py`, `program.py`, `--trace`): rules
+  DP200-DP206 over the jaxpr of every registered production jit entry
+  point, abstractly traced on CPU — carry instability, precision/weak-type
+  leaks, baked-in host constants, dead compute, collective-axis
+  mismatches, dead donations. Catches what source cannot show but a
+  device never needs to run.
 - **Runtime** (`sanitize.py`): the `--sanitize` pipeline flag — NaN
   debugging, `jax.log_compiles` routed into observe events, and a
   recompile-budget watchdog that fails the run when a jitted entry point
-  re-traces past its declared budget. Catches what only shows at runtime.
+  re-traces past its declared budget. Catches the remainder, live.
 
-The engine and rules (everything but `sanitize`) are stdlib-only logic —
-ast + tokenize, no jax API calls — so linting never initializes (and on
-shared accelerators, claims) a backend. Importing the package does pull
-jax into the process transitively via the parent package; import alone is
-backend-neutral.
+The AST engine and rules are stdlib-only logic — ast + tokenize, no jax
+API calls — so linting never initializes (and on shared accelerators,
+claims) a backend. The trace wing calls jax tracing APIs (CPU, no device
+FLOPs) and only loads under `--trace` / the auditor tests. Importing the
+package pulls jax into the process transitively via the parent package;
+import alone is backend-neutral.
 """
 
 from dorpatch_tpu.analysis.engine import (  # noqa: F401
@@ -46,4 +53,15 @@ __all__ = [
     "get_rule",
     "iter_python_files",
     "register",
+    "register_entrypoint",
 ]
+
+
+def register_entrypoint(fn, args=(), kwargs=None, name=None):
+    """Register a (non-timed) jit entry point for the `--trace` audit —
+    the public front door of `analysis.entrypoints.register_entrypoint`,
+    re-exported lazily so merely importing `dorpatch_tpu.analysis` stays
+    free of jax tracing machinery."""
+    from dorpatch_tpu.analysis.entrypoints import register_entrypoint as reg
+
+    return reg(fn, args=args, kwargs=kwargs, name=name)

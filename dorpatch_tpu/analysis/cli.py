@@ -1,22 +1,34 @@
-"""Lint CLI: `python -m dorpatch_tpu.analysis [paths...]`.
+"""Analysis CLI: `python -m dorpatch_tpu.analysis [paths...]`.
 
-Exit status is the gate contract (`run_tests.sh` runs this before pytest):
-0 = clean, 1 = findings, 2 = usage error. Stdout carries one
-`path:line:col: DPxxx message` line per finding; the summary goes to stderr
-so the finding stream stays machine-parseable.
+Three modes behind one exit contract (0 = clean, 1 = findings, 2 = usage
+error; `run_tests.sh` gates on it):
 
-The lint logic is stdlib-only and calls no jax API (see `engine.py`), so
-the gate never initializes an accelerator backend.
+- **Lint** (default): the AST rules (DP101-DP107) over the package and
+  tools — pure ast/tokenize logic, never initializes a jax backend.
+- **Trace** (`--trace`): the jaxpr-level auditor (DP200-DP206) over every
+  registered production jit entry point, abstractly traced on CPU
+  (`JAX_PLATFORMS=cpu`; zero device FLOPs). This mode imports jax and the
+  production modules — it is the one analysis mode that is not
+  backend-neutral to *import*, which is why it is opt-in.
+- **Fix** (`--fix [--diff]`): applies the mechanical DP106 rewriter
+  (`fix.py`); `--diff` prints the unified diff without writing.
+
+Output: one `path:line:col: DPxxx message` line per finding on stdout
+(`--format json` swaps in one JSON object per line for CI and the report
+tooling); the human summary goes to stderr so the finding stream stays
+machine-parseable either way.
 """
 
 from __future__ import annotations
 
 import argparse
+import importlib
+import json
 import pathlib
 import sys
 from typing import List, Optional
 
-from dorpatch_tpu.analysis.engine import all_rules, analyze_paths
+from dorpatch_tpu.analysis.engine import Finding, all_rules, analyze_paths
 
 DEFAULT_PATHS = ["dorpatch_tpu", "tools"]
 
@@ -38,25 +50,141 @@ def default_paths() -> List[str]:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m dorpatch_tpu.analysis",
-        description="JAX-aware static analysis for the dorpatch-tpu tree "
-                    "(rules DP101-DP107; see --list-rules)")
+        description="Static analysis for the dorpatch-tpu tree: AST rules "
+                    "DP101-DP107 (default) and the jaxpr-level program "
+                    "auditor DP200-DP206 (--trace); see --list-rules")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories to lint (default: "
-                        f"{' '.join(DEFAULT_PATHS)})")
+                        f"{' '.join(DEFAULT_PATHS)}; ignored under --trace)")
     p.add_argument("--select", default="",
                    help="comma-separated rule IDs to run (default: all)")
     p.add_argument("--list-rules", action="store_true",
-                   help="print the rule table and exit")
+                   help="print the rule table (AST + trace) and exit")
     p.add_argument("--fixable", action="store_true",
                    help="list only mechanically fixable offenses")
+    p.add_argument("--format", choices=("human", "json"), default="human",
+                   help="finding output format: human `path:line:col:` "
+                        "lines (default) or one JSON object per line")
+    p.add_argument("--trace", action="store_true",
+                   help="audit the registered jit entry points at the "
+                        "jaxpr level (DP2xx) instead of linting source")
+    p.add_argument("--entrypoints", default="",
+                   help="--trace source override, `module:callable` "
+                        "returning a list of EntryPoints (default: the "
+                        "production registry)")
+    p.add_argument("--fix", action="store_true",
+                   help="apply the DP106 unused-import fixer to the "
+                        "target paths (idempotent)")
+    p.add_argument("--diff", action="store_true",
+                   help="with --fix: print the unified diff, write nothing")
     return p
+
+
+def _trace_rule_table() -> List[tuple]:
+    """(id, fixable, name, description) for the trace rules. program.py
+    keeps its jax imports inside rule bodies, so building the table (for
+    `--list-rules` / `--select` validation) stays backend-neutral — no
+    accelerator is initialized, same contract as the AST wing."""
+    from dorpatch_tpu.analysis.program import DP200_ROW, all_trace_rules
+
+    rows = [(r.id, False, r.name, r.description) for r in all_trace_rules()]
+    rows.append((DP200_ROW[0], False, DP200_ROW[1], DP200_ROW[2]))
+    return rows
 
 
 def list_rules(out=None) -> None:
     out = out if out is not None else sys.stdout
-    for rule in all_rules():
-        fix = "fixable" if rule.fixable else "       "
-        out.write(f"{rule.id}  {fix}  {rule.name}: {rule.description}\n")
+    rows = [(r.id, r.fixable, r.name, r.description) for r in all_rules()]
+    rows += _trace_rule_table()
+    for rid, fixable, name, description in sorted(rows):
+        fix = "fixable" if fixable else "       "
+        out.write(f"{rid}  {fix}  {name}: {description}\n")
+
+
+def emit(findings: List[Finding], fmt: str, out=None) -> None:
+    out = out if out is not None else sys.stdout
+    for f in findings:
+        if fmt == "json":
+            out.write(json.dumps(
+                {"rule": f.rule_id, "path": f.path, "line": f.line,
+                 "col": f.col, "message": f.message,
+                 "fixable": f.fixable}) + "\n")
+        else:
+            out.write(f.render() + "\n")
+
+
+def _parse_select(raw: str, trace_mode: bool) -> Optional[List[str]]:
+    """Validate --select against the rules of the mode actually running:
+    a cross-wing ID (`--select DP201` without `--trace`, or `--trace
+    --select DP106`) would run ZERO rules and turn a CI gate into a
+    vacuous pass — it must be a loud usage error instead."""
+    if not raw:
+        return None
+    select = [s.strip().upper() for s in raw.split(",") if s.strip()]
+    from dorpatch_tpu.analysis.program import TRACE_RULE_IDS
+
+    ast_ids = {r.id for r in all_rules()} | {"DP000"}
+    trace_ids = set(TRACE_RULE_IDS)
+    known = trace_ids if trace_mode else ast_ids
+    bad = set(select) - known
+    if bad:
+        other = sorted(bad & (ast_ids if trace_mode else trace_ids))
+        if other:
+            hint = (f" ({other} are AST rules; drop --trace)" if trace_mode
+                    else f" ({other} are trace rules; add --trace)")
+        else:
+            hint = ""
+        sys.stderr.write(
+            f"rule id(s) not runnable in this mode: {sorted(bad)}{hint}\n")
+        return ["<usage-error>"]
+    return select
+
+
+def _run_fix(paths: List[str], diff_only: bool) -> int:
+    from dorpatch_tpu.analysis.fix import fix_paths
+
+    files, removed, diffs = fix_paths(paths, write=not diff_only)
+    if diff_only:
+        for d in diffs:
+            sys.stdout.write(d)
+    verb = "would remove" if diff_only else "removed"
+    sys.stderr.write(
+        f"--fix: {verb} {removed} unused import(s) across {files} "
+        "file(s)\n" if removed else "--fix: nothing to fix\n")
+    return 0
+
+
+def _run_trace(select: Optional[List[str]], spec: str,
+               fmt: str) -> int:
+    from dorpatch_tpu.analysis import entrypoints as ep_mod
+    from dorpatch_tpu.analysis import program
+
+    if spec:
+        mod_name, _, attr = spec.partition(":")
+        try:
+            loader = getattr(importlib.import_module(mod_name), attr)
+        except (ImportError, AttributeError) as e:
+            sys.stderr.write(f"cannot load --entrypoints {spec!r}: {e}\n")
+            return 2
+        eps = list(loader())
+        findings = program.audit_entrypoints(eps, select=select)
+        n_progs = len(eps)
+    else:
+        eps = ep_mod.production_entrypoints()
+        findings = program.audit_entrypoints(
+            eps, select=select, uncovered=ep_mod.uncovered_names())
+        n_progs = len(eps)
+    emit(findings, fmt)
+    if findings:
+        sys.stderr.write(
+            f"{len(findings)} trace finding(s) across {n_progs} entry "
+            "point(s). Suppress a deliberate one with `# noqa: DP2xx` on "
+            "the program's def line, or a reasoned "
+            "analysis.program.ALLOWLIST entry when no source line can "
+            "own it.\n")
+        return 1
+    sys.stderr.write(f"trace audit: {n_progs} entry point(s) clean\n")
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -64,15 +192,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.list_rules:
         list_rules()
         return 0
-    select = None
-    if args.select:
-        select = [s.strip() for s in args.select.split(",") if s.strip()]
-        known = {r.id for r in all_rules()}
-        unknown = set(select) - known
-        if unknown:
-            sys.stderr.write(f"unknown rule id(s): {sorted(unknown)}\n")
-            return 2
+    select = _parse_select(args.select, trace_mode=args.trace)
+    if select == ["<usage-error>"]:
+        return 2
+    if args.diff and not args.fix:
+        sys.stderr.write("--diff requires --fix\n")
+        return 2
+    if args.fix and args.trace:
+        sys.stderr.write("--fix and --trace are separate modes; run them "
+                         "as two invocations\n")
+        return 2
     paths = args.paths or default_paths()
+    if args.fix:
+        return _run_fix(paths, args.diff)
+    if args.trace:
+        return _run_trace(select, args.entrypoints, args.format)
     try:
         findings = analyze_paths(paths, select=select)
     except (OSError, UnicodeDecodeError) as e:
@@ -81,15 +215,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if args.fixable:
         findings = [f for f in findings if f.fixable]
-    for f in findings:
-        sys.stdout.write(f.render() + "\n")
+    emit(findings, args.format)
     n_fix = sum(1 for f in findings if f.fixable)
     if findings:
         sys.stderr.write(
             f"{len(findings)} finding(s), {n_fix} fixable. Suppress a "
-            "deliberate one with `# noqa: DPxxx <reason>`.\n")
+            "deliberate one with `# noqa: DPxxx <reason>`; run --fix for "
+            "the fixable ones.\n")
         return 1
     return 0
+
+
+def audit_main(argv: Optional[List[str]] = None) -> int:
+    """`dorpatch-audit` console script: the trace audit as a first-class
+    command (`dorpatch-audit` == `python -m dorpatch_tpu.analysis --trace`)."""
+    return main(["--trace"] + list(argv if argv is not None else sys.argv[1:]))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,335 @@
+"""Entry-point registry: the exact jit programs production compiles.
+
+The trace-level auditor (`program.py`) needs two things the AST rules never
+do: the *callable* for every jitted entry point, and abstract example
+arguments to trace it with. This module supplies both:
+
+- **Discovery** — `observe.timed_first_call` reports every wrap (and every
+  call) through the recorder hook (`observe.set_entrypoint_recorder`), so
+  constructing a subsystem under `capture_entrypoints()` records the exact
+  `(name, fn)` pairs production registers with the telemetry layer. A
+  timed entry point the enumerators construct but never attach example
+  args to is *discovered but unauditable* — the audit fails loudly on it
+  (DP200) instead of silently skipping the program.
+- **Registration** — `register_entrypoint(fn, args=...)` attaches abstract
+  example args (``jax.ShapeDtypeStruct`` pytrees, via `abstractify` /
+  `jax.eval_shape`) to a discovered wrapper, or registers a non-timed jit
+  directly under an explicit name.
+- **Enumeration** — `production_entrypoints()` constructs (without ever
+  executing) the programs the production stack compiles: the attack
+  stage-0/1 block and sweep programs, the per-radius defense
+  predict/certify tables, the train init/step/eval programs, the jitted
+  model initializer, the serve bucket programs, and (on multi-device
+  hosts) the shard_map'd masked-fill gradient with its mask-axis psum.
+  Example args are `ShapeDtypeStruct`s throughout — enumeration costs
+  tracing only, no device FLOPs — with the victim scaled to the small
+  CIFAR family so the gate stays CPU-cheap while exercising the exact
+  production code paths.
+
+Unlike the AST wing this module (and everything it enumerates) imports
+jax; only `--trace` audits and tests load it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from dorpatch_tpu import observe
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    """One auditable jit entry point: the (unwrapped) callable plus the
+    abstract example args `jax.make_jaxpr` traces it with. `kwargs` values
+    and non-array `args` leaves pass through concrete (static args)."""
+
+    name: str
+    fn: Callable
+    args: Tuple[Any, ...] = ()
+    kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: "registered" (explicit example args) or "captured" (args recorded
+    #: from a live call through the timed_first_call wrapper)
+    source: str = "registered"
+
+
+#: name -> EntryPoint with example args attached (auditable)
+_REGISTRY: Dict[str, EntryPoint] = {}
+#: every name seen through a timed_first_call wrap (discoverability ledger)
+_WRAPPED: Dict[str, Callable] = {}
+
+
+def abstractify(tree):
+    """Pytree of values -> pytree of `ShapeDtypeStruct`s (weak_type
+    preserved — the carry-stability rule depends on it); non-array leaves
+    (python ints/bools, None) pass through as static values."""
+    import jax
+
+    def leaf(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is None or dtype is None:
+            return x
+        return jax.ShapeDtypeStruct(
+            tuple(shape), dtype, weak_type=bool(getattr(x, "weak_type", False)))
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def _unwrap(fn: Callable) -> Callable:
+    """Strip `timed_first_call` wrappers — and ONLY those. The jit object
+    underneath must survive: it carries the static_argnums/donate_argnums
+    the audit traces with (`Traced.args_info`), and unwrapping past it
+    would re-abstract static arguments."""
+    from dorpatch_tpu.observe.events import _FirstCallTimer
+
+    while isinstance(fn, _FirstCallTimer):
+        fn = fn.__wrapped__
+    return fn
+
+
+def register_entrypoint(fn: Callable, args: Tuple[Any, ...] = (),
+                        kwargs: Optional[Dict[str, Any]] = None,
+                        name: Optional[str] = None) -> EntryPoint:
+    """Attach abstract example args to a jit entry point.
+
+    `fn` may be a `timed_first_call` wrapper (its registered telemetry name
+    is reused) or a bare jitted callable (pass `name`). Array-like leaves in
+    `args`/`kwargs` are abstractified; the program is never executed."""
+    resolved = name or getattr(fn, "_name", None) or getattr(
+        fn, "__name__", None)
+    if not resolved:
+        raise ValueError(f"cannot derive a name for entry point {fn!r}")
+    ep = EntryPoint(name=resolved, fn=_unwrap(fn),
+                    args=tuple(abstractify(a) for a in args),
+                    kwargs={k: abstractify(v)
+                            for k, v in (kwargs or {}).items()})
+    _REGISTRY[resolved] = ep
+    return ep
+
+
+def registered_entrypoints() -> List[EntryPoint]:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def wrapped_names() -> List[str]:
+    """Every entry-point name discovered through a timed_first_call wrap
+    since the last `clear_entrypoints` (capture scope)."""
+    return sorted(_WRAPPED)
+
+
+def uncovered_names() -> List[str]:
+    """Discovered-but-unauditable names: a `timed_first_call` site was
+    constructed, but no registration attached example args (bucketed
+    registrations like `serve.clean_predict[b8]` cover their base name)."""
+    out = []
+    for name in sorted(_WRAPPED):
+        if name in _REGISTRY:
+            continue
+        if any(r.startswith(name + "[") for r in _REGISTRY):
+            continue
+        out.append(name)
+    return out
+
+
+def clear_entrypoints() -> None:
+    _REGISTRY.clear()
+    _WRAPPED.clear()
+
+
+class _CaptureRecorder:
+    """The `observe.set_entrypoint_recorder` hook: wraps land in the
+    discoverability ledger; live calls contribute example args (abstracted
+    pre-dispatch) for any entry point not explicitly registered."""
+
+    def on_wrap(self, name: str, fn: Callable) -> None:
+        _WRAPPED[name] = fn
+
+    def on_call(self, name: str, fn: Callable, args, kwargs) -> None:
+        _WRAPPED.setdefault(name, fn)
+        if name not in _REGISTRY:
+            _REGISTRY[name] = EntryPoint(
+                name=name, fn=_unwrap(fn),
+                args=tuple(abstractify(a) for a in args),
+                kwargs={k: abstractify(v) for k, v in kwargs.items()},
+                source="captured")
+
+
+@contextlib.contextmanager
+def capture_entrypoints() -> Iterator[None]:
+    """Record every `timed_first_call` wrap/call in the scope into the
+    registry; restores any previously installed recorder on exit."""
+    prev = observe.entrypoint_recorder()
+    observe.set_entrypoint_recorder(_CaptureRecorder())
+    try:
+        yield
+    finally:
+        observe.set_entrypoint_recorder(prev)
+
+
+# ---------------------------------------------------------------- enumerators
+
+#: Victim geometry for enumeration: the small CIFAR family keeps the gate's
+#: tracing cost in CPU seconds while driving the identical production code
+#: paths (the audited invariants — carry stability, dtype discipline, axis
+#: names, constant capture — are shape-generic).
+AUDIT_IMG_SIZE = 32
+AUDIT_BATCH = 2
+AUDIT_CLASSES = 10
+
+
+def _audit_victim():
+    """Small real victim with zero-filled params (abstract-init shapes, one
+    cheap `jnp.zeros` per leaf): the attack/defense programs close over
+    `params`, so the leaves must be concrete arrays — but never random, and
+    never forwarded."""
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu.models import registry
+
+    model = registry.build_bare_model("cifar_resnet18", AUDIT_CLASSES)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    dummy = jax.ShapeDtypeStruct(
+        (1, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    shapes = jax.eval_shape(model.init, key, dummy)
+    params = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    def apply(params, images01):
+        return model.apply(params, (images01 - 0.5) / 0.5)
+
+    return apply, params
+
+
+def _enumerate_attack(apply_fn, params) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu import losses
+    from dorpatch_tpu import masks as masks_lib
+    from dorpatch_tpu.attack import DorPatch
+    from dorpatch_tpu.config import AttackConfig
+
+    cfg = AttackConfig(sampling_size=8, dropout=1, sweep_interval=50,
+                       max_iterations=100)
+    atk = DorPatch(apply_fn, params, AUDIT_CLASSES, cfg)
+    b, img = AUDIT_BATCH, AUDIT_IMG_SIZE
+    universe = abstractify(jnp.asarray(masks_lib.dropout_universe(
+        img, cfg.dropout, cfg.dropout_sizes)))
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    x = jax.ShapeDtypeStruct((b, img, img, 3), jnp.float32)
+    y = jax.ShapeDtypeStruct((b,), jnp.int32)
+    state = jax.eval_shape(
+        lambda k, xx, yy: atk._init_state(k, xx, yy, False,
+                                          universe.shape[0]), key, x, y)
+    lvx = jax.eval_shape(
+        lambda xx: jnp.mean(losses.local_variance(xx)[0], axis=-1), x)
+    for stage in (0, 1):
+        block = atk._get_block(stage, img, cfg.sweep_interval)
+        register_entrypoint(block, (state, x, lvx, universe))
+    sweep = atk._get_sweep()
+    register_entrypoint(
+        sweep, (state.adv_mask, state.adv_pattern, x, y,
+                jax.ShapeDtypeStruct((b,), jnp.bool_), universe))
+
+
+def _enumerate_defense(apply_fn, params) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu.config import DefenseConfig
+    from dorpatch_tpu.defense import build_defenses
+
+    cfg = DefenseConfig(chunk_size=64)
+    imgs = jax.ShapeDtypeStruct(
+        (AUDIT_BATCH, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    for d in build_defenses(apply_fn, AUDIT_IMG_SIZE, cfg,
+                            recompile_budget=1):
+        register_entrypoint(d._predict,
+                            (abstractify(params), imgs, AUDIT_CLASSES))
+
+
+def _enumerate_train() -> None:
+    from dorpatch_tpu import train
+
+    for fn, args in train.trace_entrypoints():
+        register_entrypoint(fn, args)
+
+
+def _enumerate_model_init() -> None:
+    from dorpatch_tpu.models import registry
+
+    prog, args = registry.init_program("cifar_resnet18", AUDIT_CLASSES,
+                                       AUDIT_IMG_SIZE)
+    register_entrypoint(prog, args)
+
+
+def _enumerate_serve(apply_fn, params) -> None:
+    from dorpatch_tpu.config import DefenseConfig, ServeConfig
+    from dorpatch_tpu.serve.service import CertifiedInferenceService
+
+    svc = CertifiedInferenceService(
+        apply_fn, params, num_classes=AUDIT_CLASSES,
+        img_size=AUDIT_IMG_SIZE,
+        serve_cfg=ServeConfig(max_batch=4, bucket_sizes=(1, 4)),
+        defense_cfg=DefenseConfig(ratios=(0.1,), chunk_size=64))
+    for name, fn, args in svc.trace_entrypoints():
+        register_entrypoint(fn, args, name=name)
+
+
+def _enumerate_sharded_ops() -> None:
+    """The multichip dry-run path: the Pallas masked-fill gradient under
+    `shard_map`, whose backward `psum`s over the mask axis — the one
+    collective the production mesh path emits (DP205's clean case).
+    Enumerated only when the host exposes multiple devices (the test gate
+    forces an 8-device virtual CPU mesh)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.device_count() < 2:
+        return
+    from jax.sharding import Mesh
+
+    from dorpatch_tpu import ops
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(1, -1), ("data", "mask"))
+    n_masks = int(mesh.shape["mask"])
+
+    # noqa-reason: an audit-only probe program, never executed — there is
+    # no run for its compile time to be accounted against
+    @jax.jit  # noqa: DP105
+    def sharded_fill_grad(imgs, rects):
+        def total(im):
+            return ops.masked_fill(im, rects, 0.5, "interpret",
+                                   mesh=mesh).sum()
+
+        # value_and_grad, both returned: a bare grad() would leave the
+        # primal shard_map dead in the jaxpr (DP204 flags exactly that)
+        return jax.value_and_grad(total)(imgs)
+
+    imgs = jax.ShapeDtypeStruct(
+        (AUDIT_BATCH, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    rects = jax.ShapeDtypeStruct((n_masks, 1, 4), jnp.int32)
+    register_entrypoint(sharded_fill_grad, (imgs, rects),
+                        name="ops.masked_fill.sharded_grad")
+
+
+def production_entrypoints(clear: bool = True) -> List[EntryPoint]:
+    """Construct — never execute — every registered production jit entry
+    point with abstract example args: the `--trace` audit's work list."""
+    if clear:
+        clear_entrypoints()
+    apply_fn, params = _audit_victim()
+    with capture_entrypoints():
+        _enumerate_attack(apply_fn, params)
+        _enumerate_defense(apply_fn, params)
+        _enumerate_train()
+        _enumerate_model_init()
+        _enumerate_serve(apply_fn, params)
+        _enumerate_sharded_ops()
+    return registered_entrypoints()

@@ -1,0 +1,631 @@
+"""Jaxpr-level program auditor: the DP2xx trace-time rule family.
+
+The AST wing (`rules_jax.py`) proves what is visible in *source*; this
+module proves what is only visible in the *traced program*. Every
+registered jit entry point (`entrypoints.py`) is traced abstractly — the
+jit AOT `.trace()` API on `ShapeDtypeStruct` example args, CPU-only, zero
+device FLOPs — and the resulting jaxpr is checked for invariants the PR 2
+runtime watchdog could previously only catch after paying a real compile:
+
+- **DP201 carry-instability** — a pytree slot that crosses the program
+  boundary as a carry (same tree structure in and out) with a different
+  dtype / weak-type / shape, or a `lax.scan`/`while_loop` whose carry
+  types fail to unify at trace time. The watchdog's bug class (the seed's
+  weak-typed `loss_best`/`lr` init re-traced every attack block), now
+  caught before any device run.
+- **DP202 precision-leak** — float64/complex128 avals at the program
+  boundary or inside any equation, and weak-typed floating outputs (a
+  python-scalar-derived value escaping the program is a promotion/retrace
+  hazard for every downstream consumer).
+- **DP203 const-bloat** — host (numpy) literal arrays above a byte
+  threshold baked into the program as closed-over constants instead of
+  passed as arguments: they inflate every executable and re-stage to
+  device on every compile. (Closed-over *device* arrays — the attack's
+  params idiom — are shared buffers and exempt.)
+- **DP204 dead-code** — equation chains whose results reach no output and
+  carry no effect, flagged when the dead chain contains real compute
+  (matmul/conv/scan/collective); cheap dead equations are endemic VJP
+  residue and stay quiet.
+- **DP205 collective-axis** — a `psum`-family collective over an axis
+  name its enclosing `shard_map`/`pmap` mesh does not bind: at run time
+  on a real multihost mesh this is a deadlock, at trace time it is one
+  string comparison.
+- **DP206 donation** — an argument declared donated whose buffer no
+  output can reuse (no shape/dtype match): the donation silently buys
+  nothing and XLA warns at compile time on device.
+
+Findings flow through the existing engine types (`engine.Finding`, stable
+IDs, `# noqa:` suppression against the entry point's defining source
+line). Rules where a source comment cannot reach the offense (traced
+lambdas, generated wrappers) use the programmatic allowlist instead:
+`ALLOWLIST` maps an entry-point-name glob to the rule IDs it may trip,
+with a reason string.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import pathlib
+from typing import (Any, Dict, Iterable, Iterator, List, Optional, Sequence,
+                    Set, Tuple)
+
+from dorpatch_tpu.analysis.engine import Finding, _parse_noqa
+from dorpatch_tpu.analysis.entrypoints import EntryPoint
+
+#: Entry-point-name glob -> {rule_id: reason}. The trace-level analog of a
+#: `# noqa:` comment, for programs whose offense has no ownable source line.
+#: Shipped entries carry their reason; everything else found in the shipped
+#: tree is FIXED, not allowlisted.
+ALLOWLIST: Dict[str, Dict[str, str]] = {
+    # flax's `Module.init` traces the full forward and keeps only the
+    # variables: the forward equations (convs/matmuls included) are dead by
+    # construction, DCE'd by XLA, and paid exactly once per process. The
+    # offense lives inside flax's tracer, not on an ownable source line.
+    "model.init.*": {"DP204": "flax init discards the traced forward"},
+    "train.init": {"DP204": "flax init discards the traced forward"},
+}
+
+#: DP203 default: constants this large belong in the argument list, where
+#: the runtime can donate/share them, not baked into the executable.
+CONST_BYTES_THRESHOLD = 128 * 1024
+
+#: DP204 reports a dead chain only when it contains one of these (real
+#: compute/communication). Cheap dead equations — broadcasts, slices,
+#: selects — are endemic VJP residue: `value_and_grad` leaves unused primal
+#: pieces in the jaxpr for XLA to DCE, ~1 per layer even in a clean model,
+#: and flagging them would bury the signal.
+_EXPENSIVE_PRIMS = {
+    "dot_general", "conv_general_dilated", "scan", "while", "cond",
+    "custom_call", "shard_map", "all_gather", "all_to_all", "psum", "psum2",
+    "reduce_scatter", "sort", "top_k",
+}
+
+_COLLECTIVE_PRIMS = {
+    "psum", "psum2", "pmin", "pmax", "pmean", "ppermute", "pbroadcast",
+    "all_gather", "all_to_all", "reduce_scatter", "axis_index", "pgather",
+    "psum_invariant",
+}
+
+
+# ---------------------------------------------------------------- plumbing
+
+@dataclasses.dataclass
+class ProgramContext:
+    """Everything a trace rule needs about one abstractly traced program."""
+
+    name: str
+    fn: Any
+    jaxpr: Any                       # ClosedJaxpr of the program body
+    args: Tuple[Any, ...]            # abstract example args (pytree leaves)
+    out_avals_tree: Any              # output avals in the fn's out pytree
+    args_info: Any                   # Traced.args_info (donation), or None
+    path: str
+    line: int
+
+
+class TraceRule:
+    """Base for jaxpr-level rules; mirrors `engine.Rule` but checks a
+    `ProgramContext` instead of a `FileContext`."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: ProgramContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: ProgramContext, message: str) -> Finding:
+        return Finding(path=ctx.path, line=ctx.line, col=1, rule_id=self.id,
+                       message=f"[{ctx.name}] {message}")
+
+
+_TRACE_REGISTRY: Dict[str, TraceRule] = {}
+
+
+def register_trace(cls):
+    if not cls.id:
+        raise ValueError(f"trace rule {cls.__name__} has no id")
+    if cls.id in _TRACE_REGISTRY:
+        raise ValueError(f"duplicate trace rule id {cls.id}")
+    _TRACE_REGISTRY[cls.id] = cls()
+    return cls
+
+
+def all_trace_rules() -> List[TraceRule]:
+    return [_TRACE_REGISTRY[k] for k in sorted(_TRACE_REGISTRY)]
+
+
+def _source_location(fn) -> Tuple[str, int]:
+    """Best-effort (file, line) of the python function under a jit/timer
+    wrapper chain — the anchor `# noqa:` suppressions attach to. For a
+    decorated function `co_firstlineno` is the first decorator line; the
+    location advances to the `def` line, where a suppression comment can
+    actually live."""
+    seen = 0
+    f = fn
+    while hasattr(f, "__wrapped__") and seen < 10:
+        f = f.__wrapped__
+        seen += 1
+    code = getattr(f, "__code__", None)
+    if code is None:
+        return "<entrypoint>", 1
+    path = code.co_filename
+    line = code.co_firstlineno
+    try:
+        lines = pathlib.Path(path).read_text(encoding="utf-8").splitlines()
+        for i in range(line - 1, min(line + 30, len(lines))):
+            stripped = lines[i].lstrip()
+            if stripped.startswith(("def ", "async def ", "lambda")):
+                line = i + 1
+                break
+    except OSError:
+        pass
+    try:
+        path = str(pathlib.Path(path).resolve().relative_to(
+            pathlib.Path.cwd()))
+    except ValueError:
+        pass
+    return path, line
+
+
+def _is_aval(x) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def _aval_str(a) -> str:
+    weak = ", weak" if getattr(a, "weak_type", False) else ""
+    return f"{a.dtype}{list(a.shape)}{weak}"
+
+
+def iter_jaxprs(closed_or_raw) -> Iterator[Any]:
+    """The jaxpr plus every sub-jaxpr reachable through equation params
+    (pjit/scan/while/cond/shard_map/custom_* bodies), depth-first."""
+    import jax
+
+    def raw(j):
+        return j.jaxpr if isinstance(j, jax.core.ClosedJaxpr) else j
+
+    stack = [closed_or_raw]
+    while stack:
+        j = stack.pop()
+        yield j
+        for eqn in raw(j).eqns:
+            for sub in _eqn_subjaxprs(eqn):
+                stack.append(sub)
+
+
+def _eqn_subjaxprs(eqn) -> List[Any]:
+    import jax
+
+    out = []
+    for v in eqn.params.values():
+        for item in (v if isinstance(v, (list, tuple)) else [v]):
+            if isinstance(item, (jax.core.Jaxpr, jax.core.ClosedJaxpr)):
+                out.append(item)
+    return out
+
+
+def _raw(j):
+    import jax
+
+    return j.jaxpr if isinstance(j, jax.core.ClosedJaxpr) else j
+
+
+# ---------------------------------------------------------------- DP201
+
+def _carry_candidates(args: Tuple[Any, ...], out_tree) -> List[Tuple[Any, Any]]:
+    """(input subtree, output subtree) pairs that plausibly form a carry:
+    the whole output against each argument, and — when the output is a
+    plain tuple/list — its elements zipped against the leading arguments
+    (the `step(state, ...) -> (state', aux...)` convention)."""
+    import jax
+
+    cands = [(a, out_tree) for a in args]
+    if type(out_tree) in (tuple, list):
+        cands.extend(zip(args, out_tree))
+    seen: List[Tuple[int, int]] = []
+    uniq = []
+    for a, o in cands:
+        key = (id(a), id(o))
+        if key in seen:
+            continue
+        seen.append(key)
+        if jax.tree_util.tree_structure(a) == jax.tree_util.tree_structure(o):
+            uniq.append((a, o))
+    return uniq
+
+
+@register_trace
+class CarryInstabilityRule(TraceRule):
+    id = "DP201"
+    name = "carry-instability"
+    description = ("carry pytree slot whose aval (dtype/weak-type/shape) "
+                   "differs between program input and output — every "
+                   "host-level iteration re-traces the program")
+
+    def check(self, ctx: ProgramContext) -> Iterator[Finding]:
+        import jax
+
+        for a_tree, o_tree in _carry_candidates(ctx.args, ctx.out_avals_tree):
+            a_paths = jax.tree_util.tree_flatten_with_path(a_tree)[0]
+            o_leaves = jax.tree_util.tree_leaves(o_tree)
+            multi = len(a_paths) > 1
+            for (kp, a), o in zip(a_paths, o_leaves):
+                if not (_is_aval(a) and _is_aval(o)):
+                    continue
+                shape_ok = tuple(a.shape) == tuple(o.shape)
+                dtype_ok = a.dtype == o.dtype
+                weak_ok = bool(getattr(a, "weak_type", False)) == \
+                    bool(getattr(o, "weak_type", False))
+                if multi:
+                    bad = not (shape_ok and dtype_ok and weak_ok)
+                else:
+                    # a single-leaf structure matches ANY array->array fn;
+                    # only an identical shape with drifting dtype/weak-type
+                    # is evidence of a carry (not a plain transformation)
+                    bad = shape_ok and not (dtype_ok and weak_ok)
+                if bad:
+                    yield self.finding(
+                        ctx,
+                        f"carry leaf {jax.tree_util.keystr(kp) or '<root>'} "
+                        f"is {_aval_str(a)} going in but {_aval_str(o)} "
+                        "coming out — the next call re-traces (weak-typed "
+                        "or mismatched init; declare explicit dtypes)")
+
+
+# ---------------------------------------------------------------- DP202
+
+@register_trace
+class PrecisionLeakRule(TraceRule):
+    id = "DP202"
+    name = "precision-leak"
+    description = ("float64/complex128 aval at a program boundary or "
+                   "inside the program, or a weak-typed floating output "
+                   "escaping the boundary")
+
+    _WIDE = ("float64", "complex128")
+
+    def check(self, ctx: ProgramContext) -> Iterator[Finding]:
+        import jax
+        import numpy as np
+
+        for side, avals in (("input", ctx.jaxpr.in_avals),
+                            ("output", ctx.jaxpr.out_avals)):
+            for i, a in enumerate(avals):
+                if not _is_aval(a):
+                    continue
+                if str(a.dtype) in self._WIDE:
+                    yield self.finding(
+                        ctx, f"{side} {i} is {_aval_str(a)} — double "
+                        "precision at a program boundary (x64 leak)")
+                elif (side == "output"
+                      and getattr(a, "weak_type", False)
+                      and np.issubdtype(a.dtype, np.floating)):
+                    yield self.finding(
+                        ctx, f"output {i} is weak-typed {_aval_str(a)} — a "
+                        "python-scalar-derived value is escaping the "
+                        "program boundary (promotion/retrace hazard)")
+        reported = 0
+        for j in iter_jaxprs(ctx.jaxpr):
+            for eqn in _raw(j).eqns:
+                for v in eqn.outvars:
+                    a = getattr(v, "aval", None)
+                    if a is not None and _is_aval(a) \
+                            and str(a.dtype) in self._WIDE:
+                        yield self.finding(
+                            ctx, f"equation `{eqn.primitive.name}` produces "
+                            f"{_aval_str(a)} inside the program (x64 leak)")
+                        reported += 1
+                        break
+                if reported >= 3:  # one program, one story: cap the noise
+                    return
+
+
+# ---------------------------------------------------------------- DP203
+
+@register_trace
+class ConstBloatRule(TraceRule):
+    id = "DP203"
+    name = "const-bloat"
+    description = ("closed-over literal array above the byte threshold "
+                   "baked into the program instead of passed as an "
+                   "argument")
+
+    threshold = CONST_BYTES_THRESHOLD
+
+    def check(self, ctx: ProgramContext) -> Iterator[Finding]:
+        import jax
+        import numpy as np
+
+        for j in iter_jaxprs(ctx.jaxpr):
+            if not isinstance(j, jax.core.ClosedJaxpr):
+                continue
+            for c in j.consts:
+                # a closed-over DEVICE array (jax.Array) is a buffer the
+                # executable references by handle — the attack's deliberate
+                # params-closure idiom shares it across every program at
+                # zero copy. A closed-over HOST array is genuinely baked:
+                # re-staged to device per program, per recompile.
+                if not isinstance(c, np.ndarray):
+                    continue
+                nbytes = getattr(c, "nbytes", 0)
+                if nbytes and nbytes > self.threshold:
+                    yield self.finding(
+                        ctx,
+                        f"closed-over host constant {c.dtype}"
+                        f"{list(c.shape)} ({nbytes / 1024:.0f} KiB > "
+                        f"{self.threshold / 1024:.0f} KiB) is baked into "
+                        "the program and re-staged on every compile — pass "
+                        "it as an argument (or device_put it once)")
+
+
+# ---------------------------------------------------------------- DP204
+
+@register_trace
+class DeadCodeRule(TraceRule):
+    id = "DP204"
+    name = "dead-code"
+    description = ("equation chain whose results reach no program output "
+                   "and carry no effect — traced and compiled for nothing")
+
+    def check(self, ctx: ProgramContext) -> Iterator[Finding]:
+        dead_prims: List[str] = []
+        for j in iter_jaxprs(ctx.jaxpr):
+            dead_prims.extend(self._dead_eqns(_raw(j)))
+        heavy = sorted(set(dead_prims) & _EXPENSIVE_PRIMS)
+        if heavy:
+            yield self.finding(
+                ctx, f"{len(dead_prims)} dead equation(s) including real "
+                f"compute ({', '.join(heavy[:3])}) — their outputs reach "
+                "no program output; delete the computation or return it")
+
+    @staticmethod
+    def _dead_eqns(jaxpr) -> List[str]:
+        import jax
+
+        live: Set[Any] = {v for v in jaxpr.outvars
+                          if not isinstance(v, jax.core.Literal)}
+        dead: List[str] = []
+        for eqn in reversed(jaxpr.eqns):
+            outs = [v for v in eqn.outvars
+                    if not isinstance(v, jax.core.DropVar)]
+            if getattr(eqn, "effects", None) or any(v in live for v in outs):
+                for v in eqn.invars:
+                    if not isinstance(v, jax.core.Literal):
+                        live.add(v)
+                # sub-jaxpr outvars feed this eqn's semantics; their own
+                # dead chains are found when iter_jaxprs visits them
+            else:
+                dead.append(eqn.primitive.name)
+        return dead
+
+
+# ---------------------------------------------------------------- DP205
+
+def _collective_axes(eqn) -> List[str]:
+    axes = eqn.params.get("axes", eqn.params.get(
+        "axis_name", eqn.params.get("axis", ())))
+    if isinstance(axes, (str,)):
+        axes = (axes,)
+    return [a for a in tuple(axes) if isinstance(a, str)]
+
+
+@register_trace
+class CollectiveAxisRule(TraceRule):
+    id = "DP205"
+    name = "collective-axis"
+    description = ("collective (psum family) over an axis name its "
+                   "enclosing shard_map/pmap mesh does not bind — a "
+                   "multihost deadlock at run time")
+
+    def check(self, ctx: ProgramContext) -> Iterator[Finding]:
+        yield from self._walk(ctx, ctx.jaxpr, frozenset())
+
+    def _walk(self, ctx: ProgramContext, j, bound: frozenset
+              ) -> Iterator[Finding]:
+        for eqn in _raw(j).eqns:
+            prim = eqn.primitive.name
+            if prim in _COLLECTIVE_PRIMS:
+                for ax in _collective_axes(eqn):
+                    if ax not in bound:
+                        yield self.finding(
+                            ctx, f"`{prim}` over axis {ax!r}, but the "
+                            f"enclosing mesh binds only "
+                            f"{sorted(bound) or '(no axes)'} — this "
+                            "deadlocks a multihost run")
+            inner_bound = bound
+            if prim == "shard_map":
+                mesh = eqn.params.get("mesh")
+                names = tuple(getattr(mesh, "axis_names", ()) or ())
+                inner_bound = bound | frozenset(names)
+            elif prim == "xla_pmap":
+                name = eqn.params.get("axis_name")
+                if isinstance(name, str):
+                    inner_bound = bound | {name}
+            for sub in _eqn_subjaxprs(eqn):
+                yield from self._walk(ctx, sub, inner_bound)
+
+
+# ---------------------------------------------------------------- DP206
+
+@register_trace
+class DonationRule(TraceRule):
+    id = "DP206"
+    name = "donation"
+    description = ("argument declared donated but no output can reuse its "
+                   "buffer (no shape/dtype match) — the donation is dead "
+                   "weight and XLA warns at every compile")
+
+    def check(self, ctx: ProgramContext) -> Iterator[Finding]:
+        import jax
+
+        if ctx.args_info is None:
+            return
+        leaves = jax.tree_util.tree_leaves(
+            ctx.args_info, is_leaf=lambda x: hasattr(x, "donated"))
+        donated = [x for x in leaves if getattr(x, "donated", False)]
+        if not donated:
+            return
+        pool: List[Tuple[Tuple[int, ...], Any]] = [
+            (tuple(a.shape), a.dtype) for a in ctx.jaxpr.out_avals]
+        for info in donated:
+            aval = getattr(info, "aval", None) or info._aval
+            key = (tuple(aval.shape), aval.dtype)
+            if key in pool:
+                pool.remove(key)  # one output reuses one donated buffer
+            else:
+                yield self.finding(
+                    ctx, f"donated argument {_aval_str(aval)} matches no "
+                    "output buffer — the donation frees nothing; drop it "
+                    "or return an updated value of the same shape/dtype")
+
+
+# ---------------------------------------------------------------- driver
+
+#: Trace-failure message fragments -> the rule that owns the failure mode.
+_ERROR_RULES = (
+    ("carry", "DP201"),
+    ("unbound axis name", "DP205"),
+)
+
+
+def allowed(name: str, rule_id: str,
+            allow: Optional[Dict[str, Dict[str, str]]] = None) -> bool:
+    """True when `ALLOWLIST` (or the per-call `allow` overlay) grants
+    `rule_id` for entry-point `name` (keys are fnmatch globs)."""
+    for table in (ALLOWLIST, allow or {}):
+        for pattern, rules in table.items():
+            if fnmatch.fnmatchcase(name, pattern) and rule_id in rules:
+                return True
+    return False
+
+
+_noqa_cache: Dict[str, Dict[int, Any]] = {}
+
+
+def _suppressed_in_source(path: str, line: int, rule_id: str) -> bool:
+    """Honor a `# noqa: DP2xx` on the entry point's `def` line, the same
+    contract the AST rules give — the allowlist covers everything a source
+    comment cannot reach."""
+    from dorpatch_tpu.analysis.engine import ALL_CODES
+
+    if path not in _noqa_cache:
+        try:
+            src = pathlib.Path(path).read_text(encoding="utf-8")
+            _noqa_cache[path] = _parse_noqa(src)
+        except OSError:
+            _noqa_cache[path] = {}
+    codes = _noqa_cache[path].get(line)
+    if codes is None:
+        return False
+    return codes == ALL_CODES or rule_id in codes
+
+
+def trace_entrypoint(ep: EntryPoint) -> Tuple[Optional[ProgramContext],
+                                              List[Finding]]:
+    """Abstractly trace one entry point. Returns (context, findings): a
+    trace failure maps to the rule owning that failure mode (scan-carry
+    TypeErrors are DP201, unbound-axis NameErrors are DP205) or to DP200 —
+    a program that cannot trace must fail the gate loudly, like a syntax
+    error fails lint."""
+    import jax
+
+    path, line = _source_location(ep.fn)
+    try:
+        if hasattr(ep.fn, "trace"):
+            traced = ep.fn.trace(*ep.args, **ep.kwargs)
+            jaxpr = traced.jaxpr
+            out_tree = jax.tree_util.tree_structure(traced.out_info)
+            out_avals_tree = jax.tree_util.tree_unflatten(
+                out_tree, jaxpr.out_avals)
+            args_info = traced.args_info
+        else:
+            jaxpr, out_shape = jax.make_jaxpr(ep.fn, return_shape=True)(
+                *ep.args, **ep.kwargs)
+            out_tree = jax.tree_util.tree_structure(out_shape)
+            out_avals_tree = jax.tree_util.tree_unflatten(
+                out_tree, jaxpr.out_avals)
+            args_info = None
+    except Exception as e:  # the error class varies by jax version
+        msg = f"{type(e).__name__}: {e}"
+        rule_id = "DP200"
+        for fragment, rid in _ERROR_RULES:
+            if fragment in msg.lower():
+                rule_id = rid
+                break
+        first = msg.splitlines()[0][:300]
+        return None, [Finding(
+            path=path, line=line, col=1, rule_id=rule_id,
+            message=f"[{ep.name}] failed to trace abstractly: {first}")]
+    return ProgramContext(name=ep.name, fn=ep.fn, jaxpr=jaxpr, args=ep.args,
+                          out_avals_tree=out_avals_tree, args_info=args_info,
+                          path=path, line=line), []
+
+
+def audit_entrypoint(ep: EntryPoint,
+                     select: Optional[Sequence[str]] = None,
+                     allow: Optional[Dict[str, Dict[str, str]]] = None
+                     ) -> List[Finding]:
+    ctx, findings = trace_entrypoint(ep)
+    if ctx is not None:
+        for rule in all_trace_rules():
+            if select is not None and rule.id not in select:
+                continue
+            findings.extend(rule.check(ctx))
+    out = []
+    for f in findings:
+        if select is not None and f.rule_id not in select:
+            continue
+        if allowed(ep.name, f.rule_id, allow):
+            continue
+        if _suppressed_in_source(f.path, f.line, f.rule_id):
+            continue
+        out.append(f)
+    return sorted(out)
+
+
+def audit_entrypoints(eps: Iterable[EntryPoint],
+                      select: Optional[Sequence[str]] = None,
+                      allow: Optional[Dict[str, Dict[str, str]]] = None,
+                      uncovered: Sequence[str] = ()) -> List[Finding]:
+    """Audit a batch of entry points; `uncovered` names (discovered via a
+    timed_first_call wrap but never given example args) are DP200 findings
+    — an unauditable production program is a hole in the gate."""
+    findings: List[Finding] = []
+    for name in uncovered:
+        if select is not None and "DP200" not in select:
+            continue
+        if not allowed(name, "DP200", allow):
+            findings.append(Finding(
+                path="<entrypoint>", line=1, col=1, rule_id="DP200",
+                message=f"[{name}] entry point was wrapped by "
+                        "timed_first_call but no example args were "
+                        "registered — the auditor cannot trace it "
+                        "(register it in analysis/entrypoints.py)"))
+    for ep in eps:
+        findings.extend(audit_entrypoint(ep, select=select, allow=allow))
+    return sorted(findings)
+
+
+def audit_production(select: Optional[Sequence[str]] = None,
+                     allow: Optional[Dict[str, Dict[str, str]]] = None
+                     ) -> List[Finding]:
+    """Enumerate + audit every registered production entry point — the
+    `--trace` gate's whole job."""
+    from dorpatch_tpu.analysis import entrypoints as ep_mod
+
+    eps = ep_mod.production_entrypoints()
+    return audit_entrypoints(eps, select=select, allow=allow,
+                             uncovered=ep_mod.uncovered_names())
+
+
+#: The trace-failure meta rule: not a registered TraceRule (it has no jaxpr
+#: to check — it IS the absence of one), but it owns a stable ID, a row in
+#: `--list-rules`, and a slot in `--select` like any other rule.
+DP200_ROW = ("DP200", "untraceable-entrypoint",
+             "registered jit entry point failed to trace abstractly (or "
+             "has no registered example args)")
+
+#: Rule IDs the trace wing owns (DP200 is the trace-failure meta rule).
+TRACE_RULE_IDS = ("DP200",) + tuple(sorted(_TRACE_REGISTRY))

@@ -481,9 +481,10 @@ class DorPatch:
                 recompile_budget=self.recompile_budget)
         return self._programs[key]
 
-    def sweep_failures(self, adv_mask, adv_pattern, x, y, targeted, universe) -> jax.Array:
-        """Full-universe failure sweep (`attack.py:384-406`): a mask index
-        fails if any image's goal is violated under it. Returns bool [n_mask]."""
+    def _get_sweep(self):
+        """The jitted full-universe sweep program, built (not executed) on
+        first use — split from `sweep_failures` so the program auditor can
+        enumerate it abstractly (`analysis/entrypoints.py`)."""
         if "sweep" not in self._programs:
 
             @partial(jax.jit, out_shardings=self._out_replicated())
@@ -503,7 +504,12 @@ class DorPatch:
             self._programs["sweep"] = observe.timed_first_call(
                 sweep, "attack.sweep",
                 recompile_budget=self.recompile_budget)
-        return self._programs["sweep"](adv_mask, adv_pattern, x, y, targeted, universe)
+        return self._programs["sweep"]
+
+    def sweep_failures(self, adv_mask, adv_pattern, x, y, targeted, universe) -> jax.Array:
+        """Full-universe failure sweep (`attack.py:384-406`): a mask index
+        fails if any image's goal is violated under it. Returns bool [n_mask]."""
+        return self._get_sweep()(adv_mask, adv_pattern, x, y, targeted, universe)
 
     # ---------- host orchestration ----------
 

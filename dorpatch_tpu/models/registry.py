@@ -91,6 +91,27 @@ def _build_flax(timm_name: str, num_classes: int, gn_impl: str = "auto"):
     raise NotImplementedError(timm_name)
 
 
+def init_program(timm_name: str, num_classes: int, img_size: int,
+                 gn_impl: str = "auto", model=None):
+    """The jitted parameter-init entry point plus abstract example args.
+
+    One builder shared by `get_model` (which passes its already-built
+    `model` and calls the program with a concrete key) and the program
+    auditor (which traces it abstractly — `analysis/entrypoints.py`), so
+    the audited initializer can never drift from the one production
+    compiles."""
+    if model is None:
+        model = _build_flax(timm_name, num_classes, gn_impl=gn_impl)
+    # jit the initializer: eager init dispatches hundreds of tiny ops,
+    # which is pathologically slow over remote-tunneled TPU backends
+    program = observe.timed_first_call(
+        jax.jit(model.init), f"model.init.{timm_name}", recompile_budget=1)
+    example_args = (jax.ShapeDtypeStruct((2,), jnp.uint32),
+                    jax.ShapeDtypeStruct((1, img_size, img_size, 3),
+                                         jnp.float32))
+    return program, example_args
+
+
 def _convert(timm_name: str, state_dict):
     if timm_name == "resnetv2_50x1_bit_distilled":
         from dorpatch_tpu.models.convert import convert_resnetv2
@@ -147,11 +168,9 @@ def get_model(
         from_checkpoint = True
     else:
         dummy = jnp.zeros((1, img_size, img_size, 3), jnp.float32)
-        # jit the initializer: eager init dispatches hundreds of tiny ops,
-        # which is pathologically slow over remote-tunneled TPU backends
-        params = observe.timed_first_call(
-            jax.jit(model.init), f"model.init.{timm_name}",
-            recompile_budget=1)(jax.random.PRNGKey(seed), dummy)
+        program, _ = init_program(timm_name, num_classes, img_size,
+                                  gn_impl=gn_impl, model=model)
+        params = program(jax.random.PRNGKey(seed), dummy)
         from_checkpoint = False
 
     def apply(params, images01):

@@ -40,10 +40,12 @@ from dorpatch_tpu.observe.events import (  # noqa: F401
     active,
     active_event_log,
     device_memory_stats,
+    entrypoint_recorder,
     events_filename,
     record_compile,
     record_event,
     recompile_guard,
+    set_entrypoint_recorder,
     set_recompile_guard,
     span,
     timed_first_call,
@@ -79,6 +81,7 @@ __all__ = [
     "active_event_log",
     "device_memory_stats",
     "elapsed",
+    "entrypoint_recorder",
     "events_filename",
     "heartbeat_filename",
     "heartbeat_gaps",
@@ -92,6 +95,7 @@ __all__ = [
     "record_event",
     "recompile_guard",
     "run_manifest",
+    "set_entrypoint_recorder",
     "set_process_index",
     "set_recompile_guard",
     "span",

@@ -272,6 +272,26 @@ def recompile_guard():
     return _RECOMPILE_GUARD
 
 
+# ------------- entry-point recorder hook (analysis/entrypoints.py) -----------
+
+# Installed by the program auditor (`dorpatch_tpu.analysis.entrypoints`): an
+# object whose `on_wrap(name, fn)` fires when `timed_first_call` wraps a
+# jitted entry point and whose `on_call(name, fn, args, kwargs)` fires before
+# every invocation through the wrapper — which is how the auditor learns the
+# exact (name, program, example arguments) production compiles, without
+# observe ever importing the analysis package. None means no recording.
+_ENTRYPOINT_RECORDER = None
+
+
+def set_entrypoint_recorder(recorder) -> None:
+    global _ENTRYPOINT_RECORDER
+    _ENTRYPOINT_RECORDER = recorder
+
+
+def entrypoint_recorder():
+    return _ENTRYPOINT_RECORDER
+
+
 class _FirstCallTimer:
     """Callable proxy recording the wrapped fn's first-call wall time as a
     `compile` event. Unknown attributes delegate to the wrapped callable, so
@@ -287,6 +307,11 @@ class _FirstCallTimer:
         functools.update_wrapper(self, fn, updated=())
 
     def __call__(self, *args, **kwargs):
+        recorder = _ENTRYPOINT_RECORDER
+        if recorder is not None:
+            # fires BEFORE dispatch: the auditor only needs the abstract
+            # argument shapes, never the execution
+            recorder.on_call(self._name, self.__wrapped__, args, kwargs)
         if self._done:
             out = self.__wrapped__(*args, **kwargs)
         else:
@@ -316,5 +341,15 @@ def timed_first_call(fn, name: str, clock=time.perf_counter,
     entry point is allowed — its `_cache_size()` upper bound. It is inert
     until the runtime sanitizer installs a recompile guard
     (`--sanitize`; `analysis/sanitize.py`), which then checks the wrapped
-    jit's cache growth after every call and fails the run on excess."""
+    jit's cache growth after every call and fails the run on excess.
+
+    When an entry-point recorder is installed (`set_entrypoint_recorder`;
+    the program auditor's capture mode), every wrap is reported through
+    `on_wrap(name, fn)` and every call through `on_call(name, fn, args,
+    kwargs)` — which is how `python -m dorpatch_tpu.analysis --trace`
+    discovers the production jit entry points without observe importing
+    the analysis package."""
+    recorder = _ENTRYPOINT_RECORDER
+    if recorder is not None:
+        recorder.on_wrap(name, fn)
     return _FirstCallTimer(fn, name, clock, recompile_budget)

@@ -287,6 +287,24 @@ class CertifiedInferenceService:
         self._warm = True
         return self.trace_counts()
 
+    def trace_entrypoints(self) -> List[tuple]:
+        """`(name, program, abstract example args)` for every serving
+        program at every shape bucket — the program auditor's enumeration
+        hook (`analysis/entrypoints.py`). Bucket-suffixed names (e.g.
+        `serve.clean_predict[b8]`) keep one registry entry per compiled
+        shape bucket; nothing is executed."""
+        out: List[tuple] = []
+        for b in self.bucket_sizes:
+            imgs = jax.ShapeDtypeStruct(
+                (b, self.img_size, self.img_size, 3), np.dtype(np.float32))
+            out.append((f"serve.clean_predict[b{b}]", self._clean,
+                        (self.params, imgs)))
+            for d in self.defenses:
+                out.append((f"defense.predict.r{d.spec.patch_ratio}[b{b}]",
+                            d._predict,
+                            (self.params, imgs, self.num_classes)))
+        return out
+
     def trace_counts(self) -> Dict[str, int]:
         """Compiled-trace count per jitted program (shape buckets seen so
         far). After warmup every value equals `len(bucket_sizes)`; the serve

@@ -92,6 +92,92 @@ def _trainable_arch(arch: str) -> str:
     return name
 
 
+def _make_optimizer(cfg: TrainConfig, total_steps: int):
+    import optax
+
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, cfg.lr, cfg.warmup_steps, max(total_steps, cfg.warmup_steps + 1))
+    return optax.adamw(sched, weight_decay=cfg.weight_decay)
+
+
+def make_train_programs(cfg: TrainConfig, model, tx, n_classes: int):
+    """The three jitted training entry points — init / train step / eval
+    step — wrapped per the DP105 telemetry contract. One builder shared by
+    the real training loop and the program auditor's enumeration
+    (`analysis/entrypoints.py`), so the audited programs cannot drift from
+    the ones production compiles.
+
+    Budgets: the train batch shape never changes (1 bucket); eval runs
+    full 500-image chunks plus at most one remainder chunk (2 buckets)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    init = observe.timed_first_call(
+        jax.jit(model.init), "train.init", recompile_budget=1)
+
+    # model normalization contract: victims see [0,1] images shifted by the
+    # pipeline's (x-0.5)/0.5 (registry.get_model) — train in the same frame
+    def loss_fn(params, key, x01, y):
+        x = _augment(key, x01)
+        logits = model.apply(params, (x - 0.5) / 0.5)
+        labels = optax.smooth_labels(
+            jax.nn.one_hot(y, n_classes), cfg.label_smoothing)
+        loss = optax.softmax_cross_entropy(logits, labels).mean()
+        return loss, (logits.argmax(-1) == y).mean()
+
+    @jax.jit
+    def train_step(params, opt_state, key, x_u8, y):
+        x01 = x_u8.astype(jnp.float32) / 255.0
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, key, x01, y)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, acc
+
+    train_step = observe.timed_first_call(
+        train_step, "train.step", recompile_budget=1)
+
+    @jax.jit
+    def eval_step(params, x_u8, y):
+        logits = model.apply(
+            params, (x_u8.astype(jnp.float32) / 255.0 - 0.5) / 0.5)
+        return (logits.argmax(-1) == y).sum()
+
+    eval_step = observe.timed_first_call(
+        eval_step, "train.eval_step", recompile_budget=2)
+    return init, train_step, eval_step
+
+
+def trace_entrypoints(cfg: Optional[TrainConfig] = None, n_classes: int = 10):
+    """(program, abstract example args) for every training entry point —
+    the auditor's enumeration hook. Everything is `jax.eval_shape`-derived:
+    no data is loaded, no parameter is materialized, no step executes."""
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu.models import registry
+
+    cfg = cfg or TrainConfig()
+    model = registry.build_bare_model(_trainable_arch(cfg.arch), n_classes)
+    tx = _make_optimizer(cfg, total_steps=100)
+    init, train_step, eval_step = make_train_programs(cfg, model, tx,
+                                                      n_classes)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    dummy = jax.ShapeDtypeStruct((1, cfg.img_size, cfg.img_size, 3),
+                                 jnp.float32)
+    params = jax.eval_shape(model.init, key, dummy)
+    opt_state = jax.eval_shape(tx.init, params)
+    x_u8 = jax.ShapeDtypeStruct(
+        (cfg.batch_size, cfg.img_size, cfg.img_size, 3), jnp.uint8)
+    y = jax.ShapeDtypeStruct((cfg.batch_size,), jnp.int32)
+    x_eval = jax.ShapeDtypeStruct((500, cfg.img_size, cfg.img_size, 3),
+                                  jnp.uint8)
+    y_eval = jax.ShapeDtypeStruct((500,), jnp.int32)
+    return [(init, (key, dummy)),
+            (train_step, (params, opt_state, key, x_u8, y)),
+            (eval_step, (params, x_eval, y_eval))]
+
+
 def train_victim(cfg: TrainConfig = TrainConfig(), log=observe.log,
                  telemetry_dir: Optional[str] = None) -> Tuple[dict, dict]:
     """Train the cfg.arch victim (cifar_resnet18 or cifar_vit) on the
@@ -131,7 +217,6 @@ def _train_victim_impl(cfg: TrainConfig, arch_name: str,
                        log) -> Tuple[dict, dict]:
     import jax
     import jax.numpy as jnp
-    import optax
 
     from dorpatch_tpu import data as data_lib
     from dorpatch_tpu import utils
@@ -152,9 +237,6 @@ def _train_victim_impl(cfg: TrainConfig, arch_name: str,
 
     model = registry.build_bare_model(arch_name, n_classes)
     key = jax.random.PRNGKey(cfg.seed)
-    params = observe.timed_first_call(
-        jax.jit(model.init), "train.init", recompile_budget=1)(
-        key, jnp.zeros((1, cfg.img_size, cfg.img_size, 3)))
 
     steps_per_epoch = len(tr_x) // cfg.batch_size
     if steps_per_epoch == 0:
@@ -165,43 +247,11 @@ def _train_victim_impl(cfg: TrainConfig, arch_name: str,
             f"{len(tr_x)} training images < batch_size {cfg.batch_size}: "
             "not enough data for one step (partial dataset?)")
     total_steps = steps_per_epoch * cfg.epochs
-    sched = optax.warmup_cosine_decay_schedule(
-        0.0, cfg.lr, cfg.warmup_steps, max(total_steps, cfg.warmup_steps + 1))
-    tx = optax.adamw(sched, weight_decay=cfg.weight_decay)
+    tx = _make_optimizer(cfg, total_steps)
+    init, train_step, eval_step = make_train_programs(cfg, model, tx,
+                                                      n_classes)
+    params = init(key, jnp.zeros((1, cfg.img_size, cfg.img_size, 3)))
     opt_state = tx.init(params)
-
-    # model normalization contract: victims see [0,1] images shifted by the
-    # pipeline's (x-0.5)/0.5 (registry.get_model) — train in the same frame
-    def loss_fn(params, key, x01, y):
-        x = _augment(key, x01)
-        logits = model.apply(params, (x - 0.5) / 0.5)
-        labels = optax.smooth_labels(
-            jax.nn.one_hot(y, n_classes), cfg.label_smoothing)
-        loss = optax.softmax_cross_entropy(logits, labels).mean()
-        return loss, (logits.argmax(-1) == y).mean()
-
-    @jax.jit
-    def train_step(params, opt_state, key, x_u8, y):
-        x01 = x_u8.astype(jnp.float32) / 255.0
-        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, key, x01, y)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss, acc
-
-    # telemetry contract (DP105): entry-point compiles land in events.jsonl.
-    # Budgets: the train batch shape never changes (1 bucket); eval runs
-    # full 500-image chunks plus at most one remainder chunk (2 buckets).
-    train_step = observe.timed_first_call(
-        train_step, "train.step", recompile_budget=1)
-
-    @jax.jit
-    def eval_step(params, x_u8, y):
-        logits = model.apply(
-            params, (x_u8.astype(jnp.float32) / 255.0 - 0.5) / 0.5)
-        return (logits.argmax(-1) == y).sum()
-
-    eval_step = observe.timed_first_call(
-        eval_step, "train.eval_step", recompile_budget=2)
 
     # uint8 on device: 4x less HBM/L2 traffic than f32, cast inside the jit
     dev_tr_x = jax.device_put((tr_x * 255).astype(np.uint8))

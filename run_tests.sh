@@ -9,6 +9,16 @@ cd "$(dirname "$0")"
 # fails on any finding.
 python -m dorpatch_tpu.analysis dorpatch_tpu tools || exit $?
 echo "static analysis: OK"
+# Gate 2: the jaxpr-level program auditor (DP200-DP206) — abstractly traces
+# every registered production jit entry point on CPU (attack block/sweep,
+# defense predict tables, train init/step/eval, model init, serve buckets,
+# sharded masked-fill on the 8-device virtual mesh). Trace-only: zero device
+# FLOPs; the timeout is the wall-clock budget (enumeration + tracing runs in
+# ~10 s, 120 s leaves room for a cold machine).
+timeout -k 10 120 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m dorpatch_tpu.analysis --trace || exit $?
+echo "program audit (--trace): OK"
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@" \
   || exit $?
 # Smoke: the offline telemetry report CLI must render the checked-in fixture

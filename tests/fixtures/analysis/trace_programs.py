@@ -1,0 +1,173 @@
+"""Fixture jit programs for the DP2xx program auditor: one deliberately
+broken builder per rule, plus clean twins. Imported by
+`tests/test_program_audit.py` and by the analysis CLI's `--entrypoints`
+override (exit-code tests run `python -m dorpatch_tpu.analysis --trace
+--entrypoints trace_programs:bad_entrypoints` with this directory on
+PYTHONPATH).
+
+The `weak_carry` builder is the regression fixture for the PR 2 seed bug:
+a `jnp.full` init without an explicit dtype is weak-typed, the program's
+strong-typed output re-traces every host-level iteration — the exact
+`loss_best`/`lr` defect the recompile watchdog caught at runtime, now
+pinned at trace time.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dorpatch_tpu.analysis.entrypoints import EntryPoint, abstractify
+
+_BIG_HOST_CONST = np.arange(100_000, dtype=np.float32)  # 391 KiB
+
+
+def _ep(name, fn, *args):
+    return EntryPoint(name=name, fn=fn,
+                      args=tuple(abstractify(a) for a in args))
+
+
+def scan_carry():
+    """DP201 via trace failure: int32 carry init, float carry out — the
+    scan cannot unify the types, and no device ever runs."""
+
+    @jax.jit
+    def program(x):
+        def body(c, _):
+            return c + 0.5, None
+
+        c, _ = lax.scan(body, jnp.asarray(0, jnp.int32), None, length=3)
+        return x + c
+
+    return _ep("fx.scan_carry", program, jnp.zeros((2,)))
+
+
+def weak_carry():
+    """DP201 via the boundary check: weak-typed carry init (the PR 2 bug
+    class — `jnp.full` with a python scalar), strong-typed carry out."""
+
+    @jax.jit
+    def step(state):
+        return state * jnp.asarray(2.0, jnp.float32)
+
+    init = jnp.full((4,), jnp.inf)  # no dtype => weak f32, deliberately
+    assert init.weak_type
+    return _ep("fx.weak_carry", step, init)
+
+
+def stable_carry():
+    """Clean twin of weak_carry: explicit dtype, aval-stable boundary."""
+
+    @jax.jit
+    def step(state):
+        return state * jnp.asarray(2.0, jnp.float32)
+
+    return _ep("fx.stable_carry", step, jnp.full((4,), jnp.inf, jnp.float32))
+
+
+def weak_output():
+    """DP202: a python-scalar-derived (weak) float escapes the boundary."""
+
+    @jax.jit
+    def program(x):
+        return x.sum(), jnp.full((2,), 3.0)
+
+    return _ep("fx.weak_output", program, jnp.zeros((4,)))
+
+
+def host_const():
+    """DP203: a 391 KiB host numpy array baked into the program."""
+
+    @jax.jit
+    def program(x):
+        return x + jnp.asarray(_BIG_HOST_CONST).sum()
+
+    return _ep("fx.host_const", program, jnp.zeros((2,)))
+
+
+def device_const():
+    """Clean twin of host_const: the same bytes as a closed-over DEVICE
+    array are a shared buffer, not program bloat (the params idiom)."""
+    dev = jnp.asarray(_BIG_HOST_CONST)
+
+    @jax.jit
+    def program(x):
+        return x + dev.sum()
+
+    return _ep("fx.device_const", program, jnp.zeros((2,)))
+
+
+def dead_matmul():
+    """DP204: a matmul whose result reaches no output."""
+
+    @jax.jit
+    def program(x, w):
+        _unused = x @ w
+        return x.sum()
+
+    return _ep("fx.dead_matmul", program, jnp.zeros((4, 4)),
+               jnp.zeros((4, 4)))
+
+
+def _mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()).reshape(-1), ("data",))
+
+
+def unbound_axis():
+    """DP205: shard_map body psum over an axis its mesh does not bind."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh()
+    program = jax.jit(shard_map(lambda x: lax.psum(x, "model"), mesh=mesh,
+                                in_specs=P("data"), out_specs=P()))
+    return _ep("fx.unbound_axis", program,
+               jnp.zeros((jax.device_count(),)))
+
+
+def bound_axis():
+    """Clean twin of unbound_axis: psum over the bound mesh axis."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh()
+    program = jax.jit(shard_map(lambda x: lax.psum(x, "data"), mesh=mesh,
+                                in_specs=P("data"), out_specs=P()))
+    return _ep("fx.bound_axis", program, jnp.zeros((jax.device_count(),)))
+
+
+def dead_donation():
+    """DP206: a donated argument no output can reuse."""
+    program = jax.jit(lambda x, y: y.sum(), donate_argnums=0)
+    return _ep("fx.dead_donation", program, jnp.zeros((4,)),
+               jnp.zeros((3,)))
+
+
+def live_donation():
+    """Clean twin of dead_donation: the output reuses the donated buffer."""
+    program = jax.jit(lambda x: x + 1.0, donate_argnums=0)
+    return _ep("fx.live_donation", program, jnp.zeros((4,)))
+
+
+#: rule id -> (positive builder, clean twin)
+PER_RULE = {
+    "DP201": (weak_carry, stable_carry),
+    "DP202": (weak_output, stable_carry),
+    "DP203": (host_const, device_const),
+    "DP204": (dead_matmul, None),
+    "DP205": (unbound_axis, bound_axis),
+    "DP206": (dead_donation, live_donation),
+}
+
+
+def bad_entrypoints():
+    """--entrypoints payload: every positive fixture (CLI must exit 1)."""
+    return [scan_carry(), weak_carry(), weak_output(), host_const(),
+            dead_matmul(), unbound_axis(), dead_donation()]
+
+
+def clean_entrypoints():
+    """--entrypoints payload: only clean programs (CLI must exit 0)."""
+    return [stable_carry(), device_const(), bound_axis(), live_donation()]

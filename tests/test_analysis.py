@@ -395,3 +395,149 @@ def test_module_entry_point_gate():
          "tests/fixtures/analysis"], cwd=REPO, capture_output=True, text=True)
     assert bad.returncode == 1
     assert "DP10" in bad.stdout
+
+
+# ---------- --format json (machine-readable findings) ----------
+
+def test_cli_json_format(tmp_path, capsys):
+    import json as json_lib
+
+    p = tmp_path / "f.py"
+    p.write_text("import json\nimport jax\nk = jax.random.PRNGKey(3)\n")
+    rc = cli_main([str(p), "--format", "json"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 1 and len(out) == 2  # DP106 + DP104, one object per line
+    recs = [json_lib.loads(line) for line in out]
+    assert {r["rule"] for r in recs} == {"DP104", "DP106"}
+    for r in recs:
+        assert r["path"] == str(p)
+        assert set(r) == {"rule", "path", "line", "col", "message",
+                          "fixable"}
+    (dp106,) = [r for r in recs if r["rule"] == "DP106"]
+    assert dp106["line"] == 1 and dp106["fixable"] is True
+
+
+def test_cli_json_clean_emits_nothing(tmp_path, capsys):
+    p = tmp_path / "clean.py"
+    p.write_text("VALUE = 1\n")
+    assert cli_main([str(p), "--format", "json"]) == 0
+    assert capsys.readouterr().out == ""
+
+
+# ---------- DP106 --fix (mechanical rewriter) ----------
+
+def _fix_tree(tmp_path):
+    """A little tree seeded with every fixable shape: the DP106 fixture,
+    a multi-alias single line, and a parenthesized multi-line import."""
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "a.py").write_text(
+        (FIXTURES / "dp106_pos.py").read_text(encoding="utf-8"),
+        encoding="utf-8")
+    (tree / "b.py").write_text(
+        "import os, sys\n"
+        "from typing import (\n"
+        "    Dict,\n"
+        "    List,\n"
+        "    Optional,\n"
+        ")\n"
+        "from pathlib import Path  # noqa: DP106 — deliberate re-export\n"
+        "def f(d: Dict[str, int]) -> Optional[int]:\n"
+        "    return sys.getsizeof(d)\n",
+        encoding="utf-8")
+    return tree
+
+
+def test_fix_then_relint_zero_dp106(tmp_path, capsys):
+    tree = _fix_tree(tmp_path)
+    assert cli_main([str(tree), "--fix"]) == 0
+    assert "removed" in capsys.readouterr().err
+    findings = analyze_paths([tree], select=["DP106"])
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # used aliases and the noqa'd line survive
+    b = (tree / "b.py").read_text(encoding="utf-8")
+    assert "import sys" in b and "Dict, Optional" in b
+    assert "from pathlib import Path  # noqa" in b
+    assert "List" not in b and "import os" not in b
+    a = (tree / "a.py").read_text(encoding="utf-8")
+    assert "import json" not in a and "VALUE = 1" in a
+
+
+def test_fix_idempotent(tmp_path, capsys):
+    """fix twice == fix once, byte for byte (the acceptance criterion)."""
+    tree = _fix_tree(tmp_path)
+    assert cli_main([str(tree), "--fix"]) == 0
+    once = {p.name: p.read_text(encoding="utf-8")
+            for p in sorted(tree.glob("*.py"))}
+    assert cli_main([str(tree), "--fix"]) == 0
+    assert "nothing to fix" in capsys.readouterr().err
+    twice = {p.name: p.read_text(encoding="utf-8")
+             for p in sorted(tree.glob("*.py"))}
+    assert once == twice
+
+
+def test_fix_diff_dry_run(tmp_path, capsys):
+    tree = _fix_tree(tmp_path)
+    before = (tree / "a.py").read_text(encoding="utf-8")
+    assert cli_main([str(tree), "--fix", "--diff"]) == 0
+    out = capsys.readouterr()
+    assert "-import json" in out.out and "would remove" in out.err
+    assert (tree / "a.py").read_text(encoding="utf-8") == before  # unwritten
+
+
+def test_fix_leaves_compound_statements_alone(tmp_path):
+    """`import os; x = 1` shares its line with another statement — line
+    surgery would clobber the neighbor, so the finding is left standing."""
+    from dorpatch_tpu.analysis.fix import fix_source
+
+    src = "import os; X = 1\n"
+    fixed, n = fix_source(src, "t.py")
+    assert n == 0 and fixed == src
+
+
+def test_diff_without_fix_is_usage_error():
+    assert cli_main(["--diff"]) == 2
+
+
+def test_fix_plus_trace_is_usage_error():
+    """`dorpatch-audit --fix` must not silently run the fixer instead of
+    the audit the user asked for."""
+    assert cli_main(["--trace", "--fix"]) == 2
+
+
+def test_cross_wing_select_is_usage_error(tmp_path, capsys):
+    """A trace-rule ID without --trace (or vice versa) would run ZERO
+    rules and pass vacuously — it must exit 2, as a miswired CI gate
+    should fail loudly (regression)."""
+    p = tmp_path / "f.py"
+    p.write_text("import json\n")
+    assert cli_main([str(p), "--select", "DP201"]) == 2
+    assert "add --trace" in capsys.readouterr().err
+    assert cli_main(["--trace", "--select", "DP106"]) == 2
+    assert "drop --trace" in capsys.readouterr().err
+
+
+def test_fix_emptied_block_gets_pass(tmp_path):
+    """Removing the only statement(s) of an indented block must leave
+    `pass`, not a SyntaxError (regression: sole-statement function body /
+    TYPE_CHECKING block)."""
+    import ast as ast_mod
+
+    from dorpatch_tpu.analysis.fix import fix_source
+
+    src = ("def f():\n"
+           "    import os\n"
+           "\n"
+           "if True:\n"
+           "    import json\n"
+           "    import sys\n"
+           "\n"
+           "VALUE = 1\n")
+    fixed, n = fix_source(src, "t.py")
+    assert n == 3
+    ast_mod.parse(fixed)  # still valid Python
+    assert "import" not in fixed
+    assert fixed.count("    pass\n") == 2
+    # idempotent: the pass-filled result re-lints clean
+    fixed2, n2 = fix_source(fixed, "t.py")
+    assert n2 == 0 and fixed2 == fixed

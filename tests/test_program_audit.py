@@ -1,0 +1,287 @@
+"""The jaxpr-level program auditor (analysis/entrypoints.py + program.py):
+per-rule positive/negative fixture programs, the entry-point registry round
+trip (every timed_first_call site discoverable and auditable), the shipped
+tree staying clean, allowlist/noqa suppression semantics, and the CLI
+`--trace` exit-code contract."""
+
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu import observe
+from dorpatch_tpu.analysis import entrypoints as ep_mod
+from dorpatch_tpu.analysis import program
+from dorpatch_tpu.analysis.cli import main as cli_main
+from dorpatch_tpu.analysis.entrypoints import (
+    EntryPoint,
+    abstractify,
+    capture_entrypoints,
+    clear_entrypoints,
+    production_entrypoints,
+    register_entrypoint,
+    registered_entrypoints,
+    uncovered_names,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "analysis"
+sys.path.insert(0, str(FIXTURES))
+
+import trace_programs  # noqa: E402  (fixture module, see path insert)
+
+
+def rule_ids(findings):
+    return sorted({f.rule_id for f in findings})
+
+
+# ---------- per-rule positives / negatives ----------
+
+@pytest.mark.parametrize("rule_id", sorted(trace_programs.PER_RULE))
+def test_trace_rule_positive_fires(rule_id):
+    pos, _ = trace_programs.PER_RULE[rule_id]
+    findings = program.audit_entrypoint(pos())
+    assert rule_id in rule_ids(findings), \
+        f"{rule_id} did not fire: {[f.render() for f in findings]}"
+
+
+@pytest.mark.parametrize("rule_id", sorted(trace_programs.PER_RULE))
+def test_trace_rule_negative_clean(rule_id):
+    _, neg = trace_programs.PER_RULE[rule_id]
+    if neg is None:
+        pytest.skip("no clean twin")
+    findings = program.audit_entrypoint(neg())
+    assert rule_id not in rule_ids(findings), \
+        f"false positive: {[f.render() for f in findings]}"
+
+
+def test_dp201_scan_carry_flagged_without_execution():
+    """Acceptance: an unstable scan carry is DP201 — and the program is
+    never executed (the trace itself fails, so it cannot be)."""
+    findings = program.audit_entrypoint(trace_programs.scan_carry())
+    assert rule_ids(findings) == ["DP201"]
+    assert "failed to trace" in findings[0].message
+
+
+def test_dp201_weak_carry_regression():
+    """The PR 2 seed bug class (weak-typed `jnp.full` carry init) is now a
+    pre-run finding, not a runtime watchdog trip."""
+    (f,) = program.audit_entrypoint(trace_programs.weak_carry())
+    assert f.rule_id == "DP201"
+    assert "weak" in f.message
+
+
+def test_dp205_unbound_axis_flagged_and_bound_clean():
+    """Acceptance: a shard_map body psum over an unbound axis is DP205;
+    the properly bound twin is clean on the 8-device CPU mesh."""
+    findings = program.audit_entrypoint(trace_programs.unbound_axis())
+    assert rule_ids(findings) == ["DP205"]
+    assert not program.audit_entrypoint(trace_programs.bound_axis())
+
+
+def test_dp205_jaxpr_walk_catches_ambient_axis():
+    """The jaxpr-walk side of DP205 (not just the trace-error mapping): a
+    program traced under an AMBIENT axis env (`make_jaxpr(axis_env=...)`)
+    carries a psum with no binder inside the jaxpr at all — exactly the
+    fragment shape that deadlocks when compiled standalone."""
+    jxp = jax.make_jaxpr(lambda x: jax.lax.psum(x, "i"),
+                         axis_env=[("i", 2)])(jnp.zeros((4,)))
+    ctx = program.ProgramContext(
+        name="fx.walk", fn=None, jaxpr=jxp, args=(), out_avals_tree=None,
+        args_info=None, path="<fx>", line=1)
+    findings = list(program._TRACE_REGISTRY["DP205"].check(ctx))
+    assert findings and findings[0].rule_id == "DP205"
+    assert "'i'" in findings[0].message
+
+
+def test_dp202_f64_leak_flagged():
+    with jax.experimental.enable_x64():
+        @jax.jit
+        def program_f64(x):
+            return x.astype(jnp.float64).sum()
+
+        ep = EntryPoint(name="fx.f64", fn=program_f64,
+                        args=(jax.ShapeDtypeStruct((4,), jnp.float32),))
+        findings = program.audit_entrypoint(ep)
+    assert "DP202" in rule_ids(findings)
+    assert any("float64" in f.message for f in findings)
+
+
+def test_dp204_attack_style_vjp_residue_stays_quiet():
+    """value_and_grad leaves cheap dead primal equations in every real
+    program; DP204 must only fire on dead REAL compute."""
+
+    @jax.jit
+    def step(w, x):
+        def loss(w):
+            return jnp.tanh(x @ w).sum()
+
+        return jax.value_and_grad(loss)(w)
+
+    ep = EntryPoint(name="fx.vjp", fn=step,
+                    args=(abstractify(jnp.zeros((4, 4))),
+                          abstractify(jnp.zeros((2, 4)))))
+    assert "DP204" not in rule_ids(program.audit_entrypoint(ep))
+
+
+# ---------- suppression: allowlist + source noqa ----------
+
+def test_allowlist_glob_suppresses():
+    assert program.allowed("model.init.cifar_vit", "DP204")
+    assert not program.allowed("model.init.cifar_vit", "DP203")
+    findings = program.audit_entrypoint(
+        trace_programs.dead_matmul(),
+        allow={"fx.dead_*": {"DP204": "fixture"}})
+    assert "DP204" not in rule_ids(findings)
+
+
+def test_noqa_on_def_line_suppresses(tmp_path):
+    mod = tmp_path / "noqa_prog.py"
+    mod.write_text(textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def weak_out(x):  # noqa: DP202 — fixture: weak output is the point
+            return jnp.full((2,), 3.0)
+    """), encoding="utf-8")
+    sys.path.insert(0, str(tmp_path))
+    try:
+        import noqa_prog
+        ep = EntryPoint(name="fx.noqa", fn=noqa_prog.weak_out,
+                        args=(abstractify(jnp.zeros((4,))),))
+        assert not program.audit_entrypoint(ep)
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("noqa_prog", None)
+
+
+# ---------- registry round trip ----------
+
+def test_capture_records_wraps_and_calls():
+    clear_entrypoints()
+    try:
+        with capture_entrypoints():
+            fn = observe.timed_first_call(
+                jax.jit(lambda x: x * 2.0), "fx.captured")
+            assert uncovered_names() == ["fx.captured"]  # wrap discovered
+            fn(jnp.ones((3,), jnp.float32))              # call attaches args
+        (ep,) = registered_entrypoints()
+        assert ep.name == "fx.captured" and ep.source == "captured"
+        assert isinstance(ep.args[0], jax.ShapeDtypeStruct)
+        assert uncovered_names() == []
+    finally:
+        clear_entrypoints()
+
+
+def test_uncovered_wrap_is_dp200():
+    findings = program.audit_entrypoints([], uncovered=["fx.orphan"])
+    assert rule_ids(findings) == ["DP200"]
+    assert "fx.orphan" in findings[0].message
+
+
+def test_register_entrypoint_uses_wrapper_name():
+    clear_entrypoints()
+    try:
+        wrapped = observe.timed_first_call(jax.jit(lambda x: x + 1),
+                                           "fx.named")
+        ep = register_entrypoint(wrapped, (jnp.zeros((2,)),))
+        assert ep.name == "fx.named"
+        # the timer wrapper is stripped; the jit object (with its static
+        # arg/donation metadata) survives
+        assert hasattr(ep.fn, "trace")
+    finally:
+        clear_entrypoints()
+
+
+def test_production_registry_round_trip():
+    """Every timed_first_call site the production stack constructs is
+    discoverable AND auditable: enumeration leaves nothing uncovered, and
+    the expected program families are all present."""
+    eps = production_entrypoints()
+    names = {e.name for e in eps}
+    expected = {
+        "attack.block.stage0.steps50", "attack.block.stage1.steps50",
+        "attack.sweep", "train.init", "train.step", "train.eval_step",
+        "model.init.cifar_resnet18", "serve.clean_predict[b1]",
+        "serve.clean_predict[b4]", "ops.masked_fill.sharded_grad",
+    }
+    assert expected <= names, f"missing: {expected - names}"
+    assert any(n.startswith("defense.predict.r") for n in names)
+    assert uncovered_names() == []
+
+
+def test_attack_init_state_strong_typed():
+    """Trace-level pin of the PR 2 fix: no leaf of the attack carry init
+    is weak-typed (a regression re-traces every block program)."""
+    from dorpatch_tpu.attack import DorPatch
+    from dorpatch_tpu.config import AttackConfig
+
+    atk = DorPatch(lambda p, x: x.mean(axis=(1, 2)), None, 3,
+                   AttackConfig(sampling_size=4, dropout=1))
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    x = jax.ShapeDtypeStruct((2, 32, 32, 3), jnp.float32)
+    y = jax.ShapeDtypeStruct((2,), jnp.int32)
+    state = jax.eval_shape(
+        lambda k, xx, yy: atk._init_state(k, xx, yy, False, 16), key, x, y)
+    weak = [jax.tree_util.keystr(kp)
+            for kp, leaf in jax.tree_util.tree_flatten_with_path(state)[0]
+            if getattr(leaf, "weak_type", False)]
+    assert not weak, f"weak-typed carry init leaves: {weak}"
+
+
+# ---------- the shipped tree stays clean ----------
+
+def test_shipped_tree_trace_clean():
+    findings = program.audit_production()
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+# ---------- CLI ----------
+
+def test_cli_trace_exit_codes(capsys):
+    rc = cli_main(["--trace", "--entrypoints",
+                   "trace_programs:clean_entrypoints"])
+    assert rc == 0
+    rc = cli_main(["--trace", "--entrypoints",
+                   "trace_programs:bad_entrypoints", "--format", "json"])
+    assert rc == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    import json as json_lib
+
+    rules = {json_lib.loads(line)["rule"] for line in out if line}
+    assert {"DP201", "DP202", "DP203", "DP204", "DP205", "DP206"} <= rules
+
+
+def test_cli_trace_select(capsys):
+    rc = cli_main(["--trace", "--select", "DP203", "--entrypoints",
+                   "trace_programs:bad_entrypoints"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "DP203" in out and "DP205" not in out
+    assert cli_main(["--trace", "--select", "DP999"]) == 2
+
+
+def test_cli_trace_bad_entrypoints_spec():
+    assert cli_main(["--trace", "--entrypoints", "no.such.module:x"]) == 2
+
+
+@pytest.mark.slow
+def test_cli_trace_production_subprocess(tmp_path):
+    """The run_tests.sh gate end-to-end: `--trace` enumerates and audits
+    the real production registry in a fresh process and exits 0."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dorpatch_tpu.analysis", "--trace"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin",
+             "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+             "HOME": str(tmp_path)})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stderr
