@@ -247,10 +247,22 @@ def _enumerate_defense(apply_fn, params) -> None:
     cfg = DefenseConfig(chunk_size=64)
     imgs = jax.ShapeDtypeStruct(
         (AUDIT_BATCH, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    params_abs = abstractify(params)
     for d in build_defenses(apply_fn, AUDIT_IMG_SIZE, cfg,
                             recompile_budget=1):
-        register_entrypoint(d._predict,
-                            (abstractify(params), imgs, AUDIT_CLASSES))
+        register_entrypoint(d._predict, (params_abs, imgs, AUDIT_CLASSES))
+        # the pruned two-phase schedule's programs (defense.prune="exact",
+        # the production default): first-round table + pair audit share the
+        # image-batch buckets; the ragged second-round row program runs at
+        # its own row buckets (declared recompile budget = bucket count on
+        # each wrapper)
+        register_entrypoint(d._phase1, (params_abs, imgs))
+        register_entrypoint(d._pairs, (params_abs, imgs))
+        w = int(d.row_bucket_sizes[0])
+        imgs_g = jax.ShapeDtypeStruct(
+            (w, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+        mask_idx = jax.ShapeDtypeStruct((w,), jnp.int32)
+        register_entrypoint(d._rows, (params_abs, imgs_g, mask_idx))
 
 
 def _enumerate_train() -> None:

@@ -109,6 +109,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="PatchCleanser mask-set patch count for the defense "
                         "bank (the reference always certifies n_patch=1; "
                         "2 = pair/triple mask sets, PatchCleanser.py:24-37)")
+    p.add_argument("--prune", default="exact",
+                   choices=["off", "exact", "consensus"],
+                   help="double-masking certification scheduling: 'exact' "
+                        "(default) runs the two-phase pruned path — "
+                        "first-round table, then only the second-round "
+                        "entries each verdict reads — with bit-identical "
+                        "verdicts; 'consensus' additionally early-exits "
+                        "first-round-unanimous images after 36 forwards "
+                        "(weaker, consensus-only certificates); 'off' is "
+                        "the exhaustive 666-forward parity oracle")
+    p.add_argument("--no-prune", dest="prune", action="store_const",
+                   const="off",
+                   help="alias for --prune off (the exhaustive parity "
+                        "oracle)")
     # serving (`python -m dorpatch_tpu.serve` reuses this parser)
     p.add_argument("--serve-port", type=int, default=8700,
                    help="HTTP front-end port for the certified-inference "
@@ -183,7 +197,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         carry_checkpoints=args.carry_checkpoints,
         attack=attack,
         defense=DefenseConfig(use_pallas=args.use_pallas,
-                              n_patch=args.defense_n_patch),
+                              n_patch=args.defense_n_patch,
+                              prune=args.prune),
         serve=ServeConfig(port=args.serve_port,
                           max_batch=args.serve_max_batch,
                           max_queue_depth=args.serve_queue_depth,

@@ -97,6 +97,27 @@ class DefenseConfig:
     mask_fill: float = 0.5          # gray fill (PatchCleanser.py:100)
     chunk_size: int = 64            # certification sweep chunking (PatchCleanser.py:102)
     use_pallas: str = "auto"        # fused mask-fill kernel: auto|on|off|interpret
+    prune: str = "exact"            # double-masking work scheduling:
+                                    #  "off"       — the exhaustive 666-mask
+                                    #    sweep in one program (parity oracle)
+                                    #  "exact" (default) — two-phase pruning:
+                                    #    first-round table, then only the
+                                    #    second-round entries the verdict
+                                    #    actually reads (minority rows for
+                                    #    disagreeing images, the pair audit
+                                    #    for unanimous ones). Verdicts are
+                                    #    bit-identical to "off" by
+                                    #    construction.
+                                    #  "consensus" — like "exact" but
+                                    #    first-round-unanimous images skip
+                                    #    the O(M^2) pair audit (36 forwards
+                                    #    total, ~18x); their certificate
+                                    #    asserts round-1 consensus only and
+                                    #    can exceed the exhaustive audit —
+                                    #    opt-in, see README "Certification".
+                                    # Meshed defenses always run "off"
+                                    # (gather/padding would re-lay-out
+                                    # sharded inputs).
 
 
 @dataclasses.dataclass(frozen=True)

@@ -24,6 +24,27 @@ Tie-breaking notes (documented deviations, metric-neutral):
   PatchCleanser paper's Lemma 1), the reference keeps the last success in an
   implementation-defined label order; we keep the success with the largest
   mask index.
+
+Pruned two-phase scheduling (`DefenseConfig.prune`, default "exact"): the
+verdict is a sparse function of the 666-entry table, so the exhaustive sweep
+overcomputes. Phase 1 runs the jitted 36-mask first-round table for the
+whole batch; the host inspects the tiny `[B, 36]` label table (the path's
+single designed sync) and dispatches only the second-round entries the
+verdict reads: first-round *disagreeing* images exit the certification
+audit immediately (a disagreement already kills the certificate — the
+first-round table is part of the two-mask set via the masking-idempotence
+diagonal) and schedule ragged (image, minority-mask) second-round rows for
+the recovery check; *unanimous* images schedule the 630-pair certificate
+audit. Both phase-2 worklists dispatch through a greedy bucket
+decomposition (`data.bucket_plan`: full buckets largest-first, one padded
+tail), so every call shape is a fixed bucket — the programs compile once
+per bucket and never retrace, and padding waste is confined to the tail.
+Verdicts are bit-identical to the exhaustive path by construction — every
+skipped entry is provably unread. Per-image executed-forward counts land in
+`PatchCleanserRecord.forwards`. `prune="consensus"` additionally lets
+unanimous images skip the pair audit (36 forwards total, ~18x): their
+certificate then asserts round-1 consensus only, which is the reference's
+early-exit *inference* answer but a strictly weaker certificate — opt-in.
 """
 
 from __future__ import annotations
@@ -42,13 +63,28 @@ from dorpatch_tpu import ops
 from dorpatch_tpu.config import DefenseConfig
 
 
+#: Legal values of `DefenseConfig.prune` (see the module docstring).
+PRUNE_MODES = ("off", "exact", "consensus")
+
+#: Sentinel for double-masked table entries the pruned path never evaluated
+#: (provably unread by the verdict); `preds_2` slots hold labels >= 0 only
+#: where a forward actually ran.
+UNEVALUATED = -1
+
+
 class PatchCleanserRecord(NamedTuple):
-    """Per-image verdict (reference `PatchCleanserRecord`, `PatchCleanser.py:121-126`)."""
+    """Per-image verdict (reference `PatchCleanserRecord`, `PatchCleanser.py:121-126`).
+
+    `preds_2` entries are `UNEVALUATED` (-1) where the pruned scheduler
+    proved the verdict never reads them. `forwards` counts the masked
+    forwards this image actually executed (bucket-padding waste excluded);
+    -1 marks records written before forward accounting existed."""
 
     prediction: int
     certification: bool
     preds_1: np.ndarray  # [M] one-masked predictions
     preds_2: np.ndarray  # [P] double-masked predictions
+    forwards: int = -1   # executed masked forwards for this image
 
 
 class PatchCleanserResult:
@@ -127,13 +163,11 @@ def masked_predictions(
 
 def _second_round_index_grid(num_masks: int) -> np.ndarray:
     """`grid[i, j]` = index into the pair table for {i, j} (diagonal -> 0,
-    patched up separately since mask_i(mask_i(x)) == mask_i(x))."""
-    grid = np.zeros((num_masks, num_masks), dtype=np.int32)
-    for i in range(num_masks):
-        for j in range(num_masks):
-            if i != j:
-                a, b = min(i, j), max(i, j)
-                grid[i, j] = masks_lib.pair_index(num_masks, a, b)
+    patched up separately since mask_i(mask_i(x)) == mask_i(x)). The
+    pair-table view of `masks.second_round_table_indices`' combined-table
+    grid — derived from it so the pair layout has one source of truth."""
+    grid = masks_lib.second_round_table_indices(num_masks) - num_masks
+    grid[np.eye(num_masks, dtype=bool)] = 0
     return grid
 
 
@@ -184,6 +218,18 @@ def double_masking_verdict(
     return pred, certified
 
 
+def _majority_np(preds_1: np.ndarray, num_classes: int) -> np.ndarray:
+    """Per-image majority label over the `[B, M]` first-round table, with
+    `double_masking_verdict`'s tie-break (smallest label with the maximal
+    count). THE host-side majority: `double_masking_verdict_np` and the
+    pruned scheduler's `host_round1` both read it, so the pruned path's
+    bit-parity contract cannot drift on the tie rule."""
+    b = preds_1.shape[0]
+    counts = np.zeros((b, num_classes), np.int64)
+    np.add.at(counts, (np.arange(b)[:, None], preds_1), 1)
+    return counts.argmax(axis=-1).astype(preds_1.dtype)
+
+
 def double_masking_verdict_np(
     preds_1: np.ndarray,
     preds_2: np.ndarray,
@@ -200,10 +246,7 @@ def double_masking_verdict_np(
     preds_2 = np.asarray(preds_2)
     grid = _second_round_index_grid(num_masks)  # [M, M]
     b = preds_1.shape[0]
-
-    counts = np.zeros((b, num_classes), np.int32)
-    np.add.at(counts, (np.arange(b)[:, None], preds_1), 1)
-    majority = counts.argmax(axis=-1).astype(preds_1.dtype)
+    majority = _majority_np(preds_1, num_classes)
 
     unanimous = (preds_1 == preds_1[:, :1]).all(axis=1)
     cert_consistent = (preds_2 == majority[:, None]).all(axis=1)
@@ -222,6 +265,206 @@ def double_masking_verdict_np(
     pred = np.where(unanimous, majority,
                     np.where(any_recovery, recovered_label, majority))
     return pred, certified
+
+
+# ------------------------------------------------------- pruned scheduling
+
+
+def host_round1(preds_1: np.ndarray, num_classes: int):
+    """Host-side round-1 inspection of the tiny `[B, M]` first-round label
+    table: (majority `[B]`, unanimous `[B]` bool). Majority comes from the
+    shared `_majority_np`, so the tie-break matches the verdict functions
+    by construction."""
+    p1 = np.asarray(preds_1)
+    majority = _majority_np(p1, num_classes)
+    unanimous = (p1 == p1[:, :1]).all(axis=1)
+    return majority, unanimous
+
+
+def schedule_round2(p1: np.ndarray, majority: np.ndarray,
+                    unanimous: np.ndarray, num_singles: int, num_pairs: int,
+                    mode: str):
+    """Decide, per image, which second-round entries the verdict reads.
+
+    Returns `(need_pairs [B] bool, row_list)` where `row_list` is the
+    ragged worklist of `(image, minority-mask)` second-round rows.
+
+    - disagreeing images exit the certificate audit after round 1
+      (certified=False is already decided) and need only their minority
+      rows for the recovery check — M forwards per row. When an image has
+      so many minority masks that its rows would cost more than the full
+      pair table (k*M >= P, i.e. k >= 18 for the 36-mask family), it is
+      routed through the pair program instead: pruning never exceeds the
+      exhaustive forward count.
+    - unanimous images need the full pair table for the certificate audit
+      ("exact") or nothing at all ("consensus" — the weaker opt-in
+      certificate; see the module docstring)."""
+    minority = p1 != majority[:, None]                       # [B, M]
+    k = minority.sum(axis=1)
+    rows_cheaper = (~unanimous) & (k * num_singles < num_pairs)
+    need_pairs = (~unanimous) & ~rows_cheaper
+    if mode == "exact":
+        need_pairs = need_pairs | unanimous
+    row_list = [(int(b), int(i))
+                for b in np.nonzero(rows_cheaper)[0]
+                for i in np.nonzero(minority[b])[0]]
+    return need_pairs, row_list
+
+
+class _PrunedPending:
+    """One in-flight pruned certification batch: created dispatch-only by
+    `PatchCleanser.begin_pruned` (phase 1 launched, nothing synced),
+    `schedule()` performs the path's single tiny host sync (the `[B, M]`
+    first-round labels) and dispatches the phase-2 programs, `finalize()`
+    materializes the phase-2 outputs and assembles the per-image records.
+    The split lets the serving worker launch phase 1 for every radius
+    before any sync, preserving cross-radius overlap on device."""
+
+    def __init__(self, pc: "PatchCleanser", params, imgs, n: int,
+                 num_classes: int, bucket_sizes, mode: str):
+        self.pc = pc
+        self.params = params
+        self.imgs = imgs           # device, possibly bucket-padded
+        self.n = n                 # real (unpadded) image count
+        self.num_classes = num_classes
+        self.bucket_sizes = bucket_sizes
+        self.mode = mode
+        self.t1 = pc._phase1(params, imgs)     # [B_pad, M], device
+        self._scheduled = False
+        self.p1 = None
+        self.majority = None
+        self.unanimous = None
+        self.pair_idx = np.zeros((0,), np.int64)
+        self.row_list = []
+        self.pair_chunks = []      # [(device [bucket, P], offset, count)]
+        self.row_chunks = []       # [(device [wb, M], w_real, entries)]
+
+    def schedule(self) -> "_PrunedPending":
+        """THE one designed host sync of the pruned path: materialize the
+        tiny first-round label table, build the ragged worklist, dispatch
+        phase 2. Idempotent."""
+        if self._scheduled:
+            return self
+        self._scheduled = True
+        pc = self.pc
+        self.p1 = np.asarray(self.t1)[:self.n]
+        self.majority, self.unanimous = host_round1(self.p1, self.num_classes)
+        need_pairs, self.row_list = schedule_round2(
+            self.p1, self.majority, self.unanimous,
+            pc.num_first, pc.num_second, self.mode)
+        self.pair_idx = np.nonzero(need_pairs)[0]
+
+        # Both worklists dispatch through a greedy bucket decomposition
+        # (`data.bucket_plan`: full buckets largest-first, one padded tail)
+        # rather than a single rounded-up call — a 34-entry worklist over
+        # buckets (1, 8, 32, 128) runs as 32 + 8, not a 128-slot program
+        # with 3.7x padding waste. Every call shape is still a bucket, so
+        # the per-bucket compile contract is unchanged. Callers without an
+        # explicit bucket ladder (sweep.py, direct robust_predict) still
+        # get one derived from their fixed batch size: the pair worklist
+        # size varies with the batch's verdict mix, and dispatching at the
+        # raw size would recompile the 630-mask program per distinct k.
+        if self.pair_idx.size:
+            k = int(self.pair_idx.size)
+            bs = (self.bucket_sizes if self.bucket_sizes is not None
+                  else data_lib.batch_buckets(int(self.imgs.shape[0])))
+            for off, cnt, bucket in data_lib.bucket_plan(k, bs):
+                xu = data_lib.pad_to_bucket(
+                    jnp.take(self.imgs,
+                             jnp.asarray(self.pair_idx[off:off + cnt]),
+                             axis=0), bucket)
+                self.pair_chunks.append((pc._pairs(self.params, xu),
+                                         off, cnt))
+
+        for off, w, wb in data_lib.bucket_plan(len(self.row_list),
+                                               pc.row_bucket_sizes):
+            chunk = self.row_list[off:off + w]
+            img_idx = [b for b, _ in chunk] + [chunk[-1][0]] * (wb - w)
+            mask_idx = [i for _, i in chunk] + [chunk[-1][1]] * (wb - w)
+            xg = jnp.take(self.imgs, jnp.asarray(img_idx), axis=0)
+            t = pc._rows(self.params, xg,
+                         jnp.asarray(mask_idx, dtype=jnp.int32))
+            self.row_chunks.append((t, w, chunk))
+        return self
+
+    def finalize(self) -> List[PatchCleanserRecord]:
+        """Materialize phase-2 outputs and assemble records (host work;
+        syncs the phase-2 prediction tables)."""
+        self.schedule()
+        pc = self.pc
+        m, p = pc.num_first, pc.num_second
+        p1, majority, unanimous = self.p1, self.majority, self.unanimous
+
+        pair_tables = {}
+        for t, off, cnt in self.pair_chunks:
+            tbl = np.asarray(t)[:cnt]
+            for pos in range(cnt):
+                pair_tables[int(self.pair_idx[off + pos])] = tbl[pos]
+        rows = {}                      # image -> {mask i -> [M] row}
+        for t, w, chunk in self.row_chunks:
+            tbl = np.asarray(t)[:w]
+            for pos, (b, i) in enumerate(chunk):
+                rows.setdefault(b, {})[i] = tbl[pos]
+
+        grid = pc._np_grid             # [M, M] into preds_2, diagonal -> 0
+        records: List[PatchCleanserRecord] = []
+        for b in range(self.n):
+            mj = int(majority[b])
+            if unanimous[b]:
+                if b in pair_tables:   # "exact": the certificate audit
+                    p2 = pair_tables[b]
+                    cert = bool((p2 == mj).all())
+                    fwd = m + p
+                else:                  # "consensus": round-1 certificate
+                    p2 = np.full((p,), UNEVALUATED, p1.dtype)
+                    cert = True
+                    fwd = m
+                records.append(PatchCleanserRecord(mj, cert, p1[b], p2, fwd))
+                continue
+            # disagreement: the certificate died in round 1; only the
+            # minority rows' recovery check remains
+            minority = np.nonzero(p1[b] != mj)[0]
+            if b in pair_tables:       # k*M >= P: full table was cheaper
+                p2 = pair_tables[b]
+                second = p2[grid]                       # [M, M]
+                second[np.eye(m, dtype=bool)] = p1[b]   # idempotence diagonal
+                brows = {int(i): second[i] for i in minority}
+                fwd = m + p
+            else:
+                p2 = np.full((p,), UNEVALUATED, p1.dtype)
+                brows = {}
+                for i in minority:
+                    row = rows[b][int(i)].copy()
+                    # the diagonal forward re-evaluates mask_i alone; pin it
+                    # to the phase-1 prediction so the recovery check reads
+                    # exactly what double_masking_verdict reads
+                    row[i] = p1[b, i]
+                    brows[int(i)] = row
+                    off = np.arange(m) != i
+                    p2[grid[i][off]] = row[off]
+                fwd = m + m * len(minority)
+            recovered = [i for i, row in brows.items()
+                         if (row == p1[b, i]).all()]
+            pred = int(p1[b, max(recovered)]) if recovered else mj
+            records.append(
+                PatchCleanserRecord(pred, False, p1[b], p2, fwd))
+        return records
+
+
+def materialize_verdicts(entry):
+    """Host-materialize one certifier's batch answer — the designated
+    device-to-host sync the serving layer's `marshal_response` delegates to.
+    `entry` is either the exhaustive `predict_tables` 4-tuple or a
+    `_PrunedPending`; returns `(pred [n], certified [n], forwards [n])`."""
+    if isinstance(entry, _PrunedPending):
+        recs = entry.finalize()
+        return (np.asarray([r.prediction for r in recs]),
+                np.asarray([r.certification for r in recs]),
+                np.asarray([r.forwards for r in recs]))
+    pred, certified, p1, p2 = entry
+    exhaustive = int(p1.shape[1]) + int(p2.shape[1])
+    pred, certified = np.asarray(pred), np.asarray(certified)
+    return pred, certified, np.full((pred.shape[0],), exhaustive)
 
 
 @dataclasses.dataclass
@@ -245,6 +488,7 @@ class PatchCleanser:
     def __post_init__(self):
         singles, doubles = masks_lib.mask_sets(self.spec)
         self._num_singles = singles.shape[0]
+        self._num_doubles = doubles.shape[0]
         k = max(singles.shape[1], doubles.shape[1])
         self._rects = jnp.asarray(
             np.concatenate(
@@ -278,6 +522,142 @@ class PatchCleanser:
             jax.jit(_predict, static_argnums=2, out_shardings=out_shardings),
             f"defense.predict.r{self.spec.patch_ratio}",
             recompile_budget=self.recompile_budget)
+        if self.mesh is None and self.spec.n_patch == 1:
+            self._build_pruned_programs()
+
+    def _build_pruned_programs(self):
+        """The two-phase pruned path's three jitted programs (single-chip,
+        n_patch=1 families only; meshed certifiers stay exhaustive — the
+        host gather/padding would re-lay-out sharded inputs)."""
+        m = self._num_singles
+        rects_first = self._rects[:m]
+        # combined-table index grid: row i = the second-round mask set of
+        # first-round mask i (diagonal -> the single mask, idempotence)
+        self._grid_full = jnp.asarray(
+            masks_lib.second_round_table_indices(m))
+        self._np_grid = _second_round_index_grid(m)
+        # ragged row worklists pad up to their own bucket ladder, capped by
+        # chunk_size: each scan step forwards a [W]-image batch, so the
+        # chunked sweep's B*chunk live-memory contract carries over
+        self.row_bucket_sizes = data_lib.batch_buckets(
+            max(1, int(self.config.chunk_size)))
+
+        def _phase1(params, imgs):
+            return masked_predictions(
+                self.apply_fn, params, imgs, rects_first,
+                self.config.chunk_size, self.config.mask_fill,
+                self.config.use_pallas)
+
+        def _pairs(params, imgs):
+            return masked_predictions(
+                self.apply_fn, params, imgs, self._rects[m:],
+                self.config.chunk_size, self.config.mask_fill,
+                self.config.use_pallas)
+
+        def _rows(params, imgs_g, mask_idx):
+            # [W,H,W,C] gathered images x [W] first-round mask ids ->
+            # [W, M] second-round rows: scan over the M second masks, each
+            # step rasterizing a PER-ENTRY rectangle set (entry w's step-j
+            # mask is {mask_idx[w], j}) and forwarding the [W] batch. The
+            # lerp fill is bitwise `ops.masked_fill`'s XLA reference path.
+            idx_tab = self._grid_full[mask_idx]           # [W, M]
+            size = self.spec.img_size
+
+            def body(carry, idx_col):                     # idx_col [W]
+                rects = self._rects[idx_col]              # [W, K, 4]
+                mk = masks_lib.rasterize(rects, size)[..., None]
+                mk = mk.astype(imgs_g.dtype)
+                xm = imgs_g * mk + self.config.mask_fill * (1.0 - mk)
+                return carry, jnp.argmax(self.apply_fn(params, xm), axis=-1)
+
+            _, out = jax.lax.scan(body, None, jnp.moveaxis(idx_tab, 0, 1))
+            return jnp.moveaxis(out, 0, 1)                # [W, M]
+
+        r = self.spec.patch_ratio
+        rb = self.recompile_budget
+        self._phase1 = observe.timed_first_call(
+            jax.jit(_phase1), f"defense.phase1.r{r}", recompile_budget=rb)
+        self._pairs = observe.timed_first_call(
+            jax.jit(_pairs), f"defense.pairs.r{r}", recompile_budget=rb)
+        self._rows = observe.timed_first_call(
+            jax.jit(_rows), f"defense.rows.r{r}",
+            recompile_budget=(len(self.row_bucket_sizes)
+                              if rb is not None else None))
+
+    @property
+    def num_first(self) -> int:
+        """First-round (one-masked) table width M."""
+        return int(self._num_singles)
+
+    @property
+    def num_second(self) -> int:
+        """Second-round (double-masked) table width P = C(M, 2)."""
+        return int(self._num_doubles)
+
+    @property
+    def num_forwards_exhaustive(self) -> int:
+        """Masked forwards per image the exhaustive sweep always executes."""
+        return self.num_first + self.num_second
+
+    def resolved_prune(self, prune: Optional[str] = None) -> str:
+        """The effective prune mode: explicit arg > config; meshed or
+        n_patch!=1 certifiers always run "off" (see _build_pruned_programs)."""
+        mode = self.config.prune if prune is None else prune
+        if mode not in PRUNE_MODES:
+            raise ValueError(
+                f"prune={mode!r} (legal: {', '.join(PRUNE_MODES)})")
+        if self.mesh is not None or self.spec.n_patch != 1:
+            return "off"
+        return mode
+
+    def begin_pruned(
+        self, params, imgs: jax.Array, num_classes: int,
+        n: Optional[int] = None,
+        bucket_sizes: Optional[Sequence[int]] = None,
+        prune: Optional[str] = None,
+    ) -> _PrunedPending:
+        """Dispatch phase 1 of the pruned certification (no host sync).
+        `imgs` may already be bucket-padded (pass the real count as `n`,
+        the serving worker's contract); otherwise it is padded here when
+        `bucket_sizes` is given. Call `.schedule()` then `.finalize()` on
+        the returned pending — or let `robust_predict` drive all three."""
+        mode = self.resolved_prune(prune)
+        if mode == "off":
+            raise ValueError("begin_pruned needs prune='exact'|'consensus'")
+        total = int(imgs.shape[0])
+        n = total if n is None else int(n)
+        if bucket_sizes is not None and n and total == n:
+            imgs = data_lib.pad_to_bucket(
+                imgs, data_lib.bucket_batch(n, bucket_sizes))
+        return _PrunedPending(self, params, imgs, n, num_classes,
+                              bucket_sizes, mode)
+
+    def warm_pruned(self, params,
+                    bucket_sizes: Sequence[int]) -> None:
+        """Compile every pruned-path program for every shape bucket it can
+        see at run time: phase 1 and the pair audit per image bucket, the
+        row program per row bucket. The serving warmup calls this so live
+        traffic provably never retraces regardless of which verdict classes
+        (and worklist sizes) it produces."""
+        size = self.spec.img_size
+        for b in bucket_sizes:
+            imgs = jnp.full((int(b), size, size, 3), 0.5, jnp.float32)
+            np.asarray(self._phase1(params, imgs))
+            np.asarray(self._pairs(params, imgs))
+        for w in self.row_bucket_sizes:
+            np.asarray(self._rows(
+                params, jnp.full((int(w), size, size, 3), 0.5, jnp.float32),
+                jnp.zeros((int(w),), jnp.int32)))
+
+    def pruned_trace_counts(self) -> dict:
+        """Compiled-trace count per pruned-path program (the serving
+        layer's zero-recompile bookkeeping)."""
+        r = self.spec.patch_ratio
+        return {
+            f"defense.phase1.r{r}": int(self._phase1._cache_size()),
+            f"defense.pairs.r{r}": int(self._pairs._cache_size()),
+            f"defense.rows.r{r}": int(self._rows._cache_size()),
+        }
 
     def predict_tables(self, params, imgs: jax.Array, num_classes: int):
         """DEVICE-resident verdict tables `(pred [B], certified [B],
@@ -291,6 +671,7 @@ class PatchCleanser:
     def robust_predict(
         self, params, imgs: jax.Array, num_classes: int,
         bucket_sizes: Optional[Sequence[int]] = None,
+        prune: Optional[str] = None,
     ) -> List[PatchCleanserRecord]:
         """Batched robust prediction + certification; returns one record per
         image (the reference's per-image `robust_predict(img, certify=True)`,
@@ -303,18 +684,29 @@ class PatchCleanser:
         otherwise force a fresh XLA compile for every distinct B. Padding
         repeats the first image; every verdict is a pure per-row function of
         the prediction tables, so padded rows cannot perturb real rows, and
-        they are sliced out of the returned records."""
+        they are sliced out of the returned records.
+
+        `prune` overrides `DefenseConfig.prune` ("off" = the exhaustive
+        666-forward sweep, the parity oracle; "exact" = two-phase pruned
+        scheduling with bit-identical verdicts; "consensus" = additionally
+        early-exit unanimous images after round 1 — weaker certificates,
+        see the module docstring)."""
         n = int(imgs.shape[0])
+        mode = self.resolved_prune(prune)
+        if n and mode != "off":
+            pending = self.begin_pruned(params, imgs, num_classes,
+                                        bucket_sizes=bucket_sizes,
+                                        prune=mode)
+            return pending.schedule().finalize()
         if bucket_sizes is not None and n:
-            m = data_lib.bucket_batch(n, bucket_sizes)
-            if m > n:
-                fill = jnp.broadcast_to(imgs[:1], (m - n,) + imgs.shape[1:])
-                imgs = jnp.concatenate([imgs, fill], axis=0)
+            imgs = data_lib.pad_to_bucket(
+                imgs, data_lib.bucket_batch(n, bucket_sizes))
         pred, certified, p1, p2 = self.predict_tables(params, imgs,
                                                       num_classes)
         pred, certified, p1, p2 = map(np.asarray, (pred, certified, p1, p2))
         return [
-            PatchCleanserRecord(int(pred[b]), bool(certified[b]), p1[b], p2[b])
+            PatchCleanserRecord(int(pred[b]), bool(certified[b]), p1[b],
+                                p2[b], self.num_forwards_exhaustive)
             for b in range(n)
         ]
 

@@ -107,6 +107,23 @@ def pair_index(n: int, i: np.ndarray, j: np.ndarray) -> np.ndarray:
     return (i * (2 * n - i - 1)) // 2 + (j - i - 1)
 
 
+def second_round_table_indices(n: int) -> np.ndarray:
+    """`grid[i, j]` = index of the {i, j} double-mask into the COMBINED
+    `[singles; pairs]` rectangle table (`defense.PatchCleanser._rects`
+    layout: n single masks first, then the C(n,2) pairs).
+
+    The diagonal maps to the single mask itself — masking is idempotent
+    (`mask_i(mask_i(x)) == mask_i(x)`), so row i of this grid is exactly the
+    mask set of PatchCleanser's second round for first-round mask i. The
+    pruned certifier's ragged row program gathers its per-entry mask sets
+    through this grid, and `defense._second_round_index_grid` derives its
+    pair-table view from it (one pair-layout source of truth)."""
+    ii, jj = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    grid = n + pair_index(n, np.minimum(ii, jj), np.maximum(ii, jj))
+    grid[np.eye(n, dtype=bool)] = np.arange(n)
+    return grid.astype(np.int32)
+
+
 def mask_sets(spec: MaskSpec) -> Tuple[np.ndarray, np.ndarray]:
     """(mask_set, double_mask_set) as rectangle sets.
 

@@ -146,6 +146,12 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
     certify_spans = [s for s in spans if s.get("name") == "certify"]
     certify_seconds = sum(float(s.get("dur_s", 0.0)) for s in certify_spans)
     certify_images = sum(int(s.get("images", 0)) for s in certify_spans)
+    # pruned-certification accounting (PR 5): executed vs
+    # exhaustive-equivalent masked forwards, from the span attrs the
+    # pipeline records per batch; zero on pre-prune telemetry
+    certify_fwd = sum(int(s.get("forwards", 0)) for s in certify_spans)
+    certify_exh = sum(int(s.get("forwards_exhaustive", 0))
+                      for s in certify_spans)
 
     peak_mem = 0
     for b in blocks:
@@ -203,6 +209,13 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
             "images": certify_images,
             "images_per_sec": round(certify_images / certify_seconds, 3)
             if certify_seconds and certify_images else 0.0,
+            "forwards": certify_fwd,
+            "forwards_per_image": round(certify_fwd / certify_images, 1)
+            if certify_fwd and certify_images else None,
+            "prune_rate": round(1.0 - certify_fwd / certify_exh, 4)
+            if certify_fwd and certify_exh else None,
+            "exhaustive_speedup": round(certify_exh / certify_fwd, 2)
+            if certify_fwd and certify_exh else None,
         },
         "mfu": mfu,
         "serve": serve,
@@ -237,6 +250,12 @@ def _summarize_serve(ev: List[dict]) -> Optional[dict]:
         by_status[st] = by_status.get(st, 0) + 1
     ok_lat = sorted(float(r.get("latency_s", 0.0)) for r in reqs
                     if r.get("status") == "ok")
+    # per-request certify cost (pruned-scheduling PR): executed masked
+    # forwards vs the bank's exhaustive-equivalent, stamped on ok events
+    fwd = sum(int(r.get("forwards", 0)) for r in reqs
+              if r.get("status") == "ok")
+    fwd_exh = sum(int(r.get("forwards_exhaustive", 0)) for r in reqs
+                  if r.get("status") == "ok")
     total = sum(by_status.values())
     rejected = by_status.get("overloaded", 0)
     ts = [float(r["ts"]) for r in reqs if "ts" in r]
@@ -256,6 +275,10 @@ def _summarize_serve(ev: List[dict]) -> Optional[dict]:
                                    for b in batches), 3),
         "occupancy": round(images / slots, 4) if slots else None,
         "reject_rate": round(rejected / total, 4) if total else 0.0,
+        "certify_forwards_per_request": round(fwd / len(ok_lat), 1)
+        if fwd and ok_lat else None,
+        "certify_prune_rate": round(1.0 - fwd / fwd_exh, 4)
+        if fwd and fwd_exh else None,
     }
 
 
@@ -317,6 +340,12 @@ def format_report(s: dict) -> str:
         f"generated -> {a['images_per_sec']} images/sec")
     add(f"  certify: {ce['images']} images in {ce['seconds']}s -> "
         f"{ce['images_per_sec']} images/sec")
+    if ce.get("forwards_per_image"):
+        prune = (f", prune rate {100.0 * ce['prune_rate']:.1f}%, "
+                 f"{ce['exhaustive_speedup']}x vs exhaustive"
+                 if ce.get("prune_rate") is not None else "")
+        add(f"  certify forwards: {ce['forwards_per_image']} "
+            f"executed/image{prune}")
     if s["mfu"]:
         add(f"  mfu: {s['mfu'].get('mfu')} "
             f"({s['mfu'].get('achieved_tflops')} TFLOP/s achieved)")
@@ -340,6 +369,11 @@ def format_report(s: dict) -> str:
                if sv["occupancy"] is not None else "n/a")
         add(f"  batches: {sv['batches']} in {sv['batch_seconds']}s, "
             f"occupancy {occ}, reject rate {100.0 * sv['reject_rate']:.1f}%")
+        if sv.get("certify_forwards_per_request"):
+            prune = (f", prune rate {100.0 * sv['certify_prune_rate']:.1f}%"
+                     if sv.get("certify_prune_rate") is not None else "")
+            add(f"  certify forwards: "
+                f"{sv['certify_forwards_per_request']}/request{prune}")
 
     add("-- heartbeats --")
     if not s["heartbeats"]:

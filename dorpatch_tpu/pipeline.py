@@ -323,13 +323,23 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                     recs = None
                 if recs is None:
                     with observe.span("certify", batch=i,
-                                      images=int(x.shape[0])):
+                                      images=int(x.shape[0])) as sp_cert:
                         per_defense = [
                             d.robust_predict(victim.params, adv_x,
                                              victim.num_classes,
                                              bucket_sizes=cert_buckets)
                             for d in defenses
                         ]
+                        # executed vs exhaustive masked-forward accounting
+                        # (observe.report derives prune rate / speedup from
+                        # these span attrs; pruning is a no-op on the mesh
+                        # path, where the two totals coincide)
+                        sp_cert["forwards"] = sum(
+                            max(0, r.forwards)
+                            for recs_d in per_defense for r in recs_d)
+                        sp_cert["forwards_exhaustive"] = int(
+                            x.shape[0]) * sum(d.num_forwards_exhaustive
+                                              for d in defenses)
                     # records_batch[img][defense], the reference's nesting
                     recs = [list(r) for r in zip(*per_defense)]
                     with observe.span("artifact_io", op="save_pc_records"):

@@ -8,8 +8,8 @@ Request lifecycle:
    `MicroBatcher`'s bounded queue — or returns a typed `Overloaded` reject
    when the queue is at depth (backpressure, never unbounded queueing).
 2. The single worker thread pops a batch on a size-or-deadline trigger,
-   pads it (repeating the last real row) up to the nearest shape bucket
-   (`data.batch_buckets`), and drives the jitted programs: one undefended
+   pads it up to the nearest shape bucket (`data.pad_to_bucket` /
+   `data.batch_buckets`), and drives the jitted programs: one undefended
    forward plus the full PatchCleanser defense bank. Every program was
    compiled for every bucket at startup warmup and is registered with the
    PR 2 recompile watchdog (`timed_first_call(..., recompile_budget=
@@ -42,6 +42,7 @@ import jax
 import numpy as np
 
 from dorpatch_tpu import data as data_lib
+from dorpatch_tpu import defense as defense_lib
 from dorpatch_tpu import observe
 from dorpatch_tpu.config import DefenseConfig, ExperimentConfig, ServeConfig
 from dorpatch_tpu.defense import build_defenses
@@ -63,20 +64,21 @@ def resolved_bucket_sizes(cfg: ServeConfig) -> Sequence[int]:
 
 
 def marshal_response(reqs: List[PendingRequest], clean_logits,
-                     per_defense: List[tuple], ratios: Sequence[float],
+                     per_defense: List[Any], ratios: Sequence[float],
                      bucket: int, clock=time.perf_counter) -> List[Any]:
     """THE designated response-marshalling function: the only place in
     `serve/` allowed to synchronize device results to the host (lint rule
     DP107 flags `.item()`/`device_get`/`block_until_ready` anywhere else in
     this package). By the time this runs, every program in the batch has
-    been DISPATCHED (`per_defense` holds the device-resident
-    `PatchCleanser.predict_tables` tuples), so the transfers here are the
-    batch's only blocking points. Slices the real rows out of the
-    padded-bucket results, enforces each request's deadline, and builds the
-    typed responses."""
+    been DISPATCHED (`per_defense` holds either device-resident
+    `PatchCleanser.predict_tables` tuples or scheduled pruned pendings),
+    so the transfers here — including the pruned finalize inside
+    `defense.materialize_verdicts` — are the batch's only blocking points.
+    Slices the real rows out of the padded-bucket results, enforces each
+    request's deadline, and builds the typed responses."""
     clean = np.asarray(clean_logits).argmax(axis=-1)
-    tables = [(np.asarray(pred), np.asarray(cert))
-              for pred, cert, _p1, _p2 in per_defense]
+    tables = [defense_lib.materialize_verdicts(entry)
+              for entry in per_defense]
     now = clock()
     out: List[Any] = []
     for i, r in enumerate(reqs):
@@ -88,7 +90,7 @@ def marshal_response(reqs: List[PendingRequest], clean_logits,
         verdicts = tuple(
             RadiusVerdict(ratio=float(ratio), prediction=int(pred[i]),
                           certified=bool(cert[i]))
-            for ratio, (pred, cert) in zip(ratios, tables)
+            for ratio, (pred, cert, _fwd) in zip(ratios, tables)
         )
         out.append(PredictResult(
             prediction=verdicts[0].prediction,
@@ -98,6 +100,8 @@ def marshal_response(reqs: List[PendingRequest], clean_logits,
             latency_ms=latency_ms,
             bucket=int(bucket),
             batch_images=len(reqs),
+            certify_forwards=sum(int(fwd[i])
+                                 for _p, _c, fwd in tables),
         ))
     return out
 
@@ -149,11 +153,21 @@ class CertifiedInferenceService:
         self.defenses = build_defenses(apply_fn, img_size, defense_cfg,
                                        recompile_budget=n_buckets)
         self.ratios = tuple(defense_cfg.ratios)
+        # effective double-masking schedule ("off" | "exact" | "consensus",
+        # resolved once — n_patch!=1 families force "off"): pruned modes
+        # schedule only the second-round work each verdict actually reads
+        # ("exact" prunes disagreeing images to their minority rows;
+        # "consensus" additionally answers first-round-unanimous traffic
+        # from the 36-mask table alone, with round-1-only certificates)
+        self.prune = (self.defenses[0].resolved_prune()
+                      if self.defenses else "off")
 
         self._lock = threading.Lock()
         self._counts = {"received": 0, "completed": 0, "rejected": 0,
                         "deadline_exceeded": 0, "errors": 0, "batches": 0,
-                        "batch_images": 0, "batch_slots": 0}
+                        "batch_images": 0, "batch_slots": 0,
+                        "certify_forwards": 0,
+                        "certify_forwards_exhaustive": 0}
         self._latencies_ms: List[float] = []
         self._worker: Optional[threading.Thread] = None
         self._stack: Optional[contextlib.ExitStack] = None
@@ -273,16 +287,35 @@ class CertifiedInferenceService:
         """Compile every program for every shape bucket (the whole cost of
         serving happens HERE, before traffic). Returns the per-program trace
         counts — the baseline the zero-recompile contract is checked
-        against."""
+        against. Under a pruned schedule the bucket loop warms the clean
+        forward only and `PatchCleanser.warm_pruned` compiles the certify
+        programs exactly once per shape — phase 1 + pair audit per image
+        bucket, the row program per row bucket (dispatching the certifiers
+        here too would re-execute the same sweeps a second time): live
+        traffic decides per batch which verdict classes — and therefore
+        which ragged second-round shapes — occur, and all of them must
+        already be compiled."""
         for b in self.bucket_sizes:
             t0 = self._clock()
             dummy = np.full((b, self.img_size, self.img_size, 3), 0.5,
                             np.float32)
-            logits, per_defense = self._dispatch(jax.device_put(dummy))
+            if self.prune == "off":
+                logits, per_defense = self._dispatch(jax.device_put(dummy), b)
+            else:
+                logits, per_defense = self._clean(self.params,
+                                                  jax.device_put(dummy)), []
             # marshalling doubles as the completion sync for the warmup call
             marshal_response([], logits, per_defense, self.ratios, b,
                              clock=self._clock)
             observe.record_event("serve.warmup", bucket=int(b),
+                                 dur_s=round(self._clock() - t0, 6))
+        if self.prune != "off":
+            t0 = self._clock()
+            for d in self.defenses:
+                d.warm_pruned(self.params, self.bucket_sizes)
+            observe.record_event("serve.warmup_pruned",
+                                 row_buckets=[int(w) for w in
+                                              self.defenses[0].row_bucket_sizes],
                                  dur_s=round(self._clock() - t0, 6))
         self._warm = True
         return self.trace_counts()
@@ -300,19 +333,39 @@ class CertifiedInferenceService:
             out.append((f"serve.clean_predict[b{b}]", self._clean,
                         (self.params, imgs)))
             for d in self.defenses:
-                out.append((f"defense.predict.r{d.spec.patch_ratio}[b{b}]",
-                            d._predict,
+                r = d.spec.patch_ratio
+                out.append((f"defense.predict.r{r}[b{b}]", d._predict,
                             (self.params, imgs, self.num_classes)))
+                if self.prune != "off":
+                    out.append((f"defense.phase1.r{r}[b{b}]", d._phase1,
+                                (self.params, imgs)))
+                    out.append((f"defense.pairs.r{r}[b{b}]", d._pairs,
+                                (self.params, imgs)))
+        if self.prune != "off":
+            for d in self.defenses:
+                r = d.spec.patch_ratio
+                for w in d.row_bucket_sizes:
+                    imgs_g = jax.ShapeDtypeStruct(
+                        (int(w), self.img_size, self.img_size, 3),
+                        np.dtype(np.float32))
+                    mask_idx = jax.ShapeDtypeStruct((int(w),),
+                                                    np.dtype(np.int32))
+                    out.append((f"defense.rows.r{r}[w{w}]", d._rows,
+                                (self.params, imgs_g, mask_idx)))
         return out
 
     def trace_counts(self) -> Dict[str, int]:
         """Compiled-trace count per jitted program (shape buckets seen so
-        far). After warmup every value equals `len(bucket_sizes)`; the serve
-        e2e asserts this dict is IDENTICAL before and after traffic."""
+        far). After warmup the clean forward (and, pruned: phase 1 + pair
+        audit) sit at `len(bucket_sizes)` and the row program at
+        `len(row_bucket_sizes)`; the serve e2e asserts this dict is
+        IDENTICAL before and after traffic."""
         out = {"serve.clean_predict": int(self._clean._cache_size())}
         for d in self.defenses:
             name = f"defense.predict.r{d.spec.patch_ratio}"
             out[name] = int(d._predict._cache_size())
+            if self.prune != "off":
+                out.update(d.pruned_trace_counts())
         return out
 
     # ---------------- client API ----------------
@@ -409,6 +462,19 @@ class CertifiedInferenceService:
             lats = sorted(self._latencies_ms)
         s["occupancy"] = (round(s["batch_images"] / s["batch_slots"], 4)
                           if s["batch_slots"] else 0.0)
+        # certification-cost summary: mean executed masked forwards per
+        # answered request, and the fraction of the exhaustive sweep the
+        # pruned scheduler skipped (0.0 when prune=off)
+        s["prune"] = self.prune
+        fwd, exh = s.pop("certify_forwards"), \
+            s.pop("certify_forwards_exhaustive")
+        s["certify_forwards"] = {
+            "total": fwd,
+            "per_request": round(fwd / s["completed"], 1)
+            if s["completed"] else None,
+            "prune_rate": round(1.0 - fwd / exh, 4) if exh else None,
+            "speedup_equivalent": round(exh / fwd, 2) if fwd else None,
+        }
         # denominator = every terminal outcome, matching the report CLI's
         # all-serve.request-events accounting, so /stats and the offline
         # report agree on the same run
@@ -425,15 +491,28 @@ class CertifiedInferenceService:
 
     # ---------------- worker ----------------
 
-    def _dispatch(self, x):
-        """Dispatch-only: launch the clean forward and EVERY certifier
-        before any result is materialized (the syncs all happen later, in
-        `marshal_response`), so the programs overlap on device instead of
-        serializing on per-radius host transfers."""
+    def _dispatch(self, x, n_real: int):
+        """Launch the clean forward and EVERY certifier before materializing
+        any result, so the programs overlap on device instead of serializing
+        on per-radius host transfers. Exhaustive mode is dispatch-only (the
+        syncs all happen later, in `marshal_response`); a pruned schedule
+        launches phase 1 for every radius first, then lets each certifier's
+        `schedule()` read its tiny `[B, 36]` first-round table (the pruned
+        path's one designed sync, inside defense.py) and dispatch only the
+        phase-2 work the batch's verdicts actually need — on benign,
+        first-round-unanimous traffic that is the 630-pair audit alone, and
+        under "consensus" nothing at all."""
         logits = self._clean(self.params, x)
-        per_defense = [d.predict_tables(self.params, x, self.num_classes)
-                       for d in self.defenses]
-        return logits, per_defense
+        if self.prune == "off":
+            per_defense = [d.predict_tables(self.params, x, self.num_classes)
+                           for d in self.defenses]
+            return logits, per_defense
+        pendings = [d.begin_pruned(self.params, x, self.num_classes,
+                                   n=n_real, bucket_sizes=self.bucket_sizes)
+                    for d in self.defenses]
+        for p in pendings:
+            p.schedule()
+        return logits, pendings
 
     def _worker_loop(self) -> None:
         while True:
@@ -490,14 +569,11 @@ class CertifiedInferenceService:
         bucket = data_lib.bucket_batch(n, self.bucket_sizes)
         with observe.span("serve.batch", bucket=int(bucket), images=n,
                           queue_depth=self.batcher.qsize()) as sp:
-            # pad on the host (repeat the last real row) so exactly ONE
-            # host->device transfer happens per batch, always bucket-shaped
-            imgs = np.stack([r.image for r in reqs])
-            if bucket > n:
-                pad = np.broadcast_to(imgs[-1:],
-                                      (bucket - n,) + imgs.shape[1:])
-                imgs = np.concatenate([imgs, pad], axis=0)
-            logits, per_defense = self._dispatch(jax.device_put(imgs))
+            # pad on the host so exactly ONE host->device transfer
+            # happens per batch, always bucket-shaped
+            imgs = data_lib.pad_to_bucket(np.stack([r.image for r in reqs]),
+                                          bucket)
+            logits, per_defense = self._dispatch(jax.device_put(imgs), n)
             responses = marshal_response(reqs, logits, per_defense,
                                          self.ratios, bucket,
                                          clock=self._clock)
@@ -505,16 +581,30 @@ class CertifiedInferenceService:
             # that returns from predict() must observe its own completion
             # in stats()
             ok = 0
+            exhaustive = sum(d.num_forwards_exhaustive
+                             for d in self.defenses)
             for r, resp in zip(reqs, responses):
                 status = resp.status
                 lat = getattr(resp, "latency_ms", None)
+                fwd = getattr(resp, "certify_forwards", None)
+                extra = {}
+                if status == "ok" and fwd is not None:
+                    # per-request certify cost, for the report CLI's serve
+                    # prune-rate column (exhaustive = the bank's fixed
+                    # 666-per-radius forward count)
+                    extra = {"forwards": int(fwd),
+                             "forwards_exhaustive": exhaustive}
                 observe.record_event("serve.request", status=status,
                                      latency_s=round((lat or 0.0) / 1e3, 6),
-                                     bucket=int(bucket))
+                                     bucket=int(bucket), **extra)
                 with self._lock:
                     if status == "ok":
                         ok += 1
                         self._counts["completed"] += 1
+                        if fwd is not None:
+                            self._counts["certify_forwards"] += int(fwd)
+                            self._counts["certify_forwards_exhaustive"] += \
+                                exhaustive
                         self._latencies_ms.append(lat)
                         if len(self._latencies_ms) > 8192:
                             del self._latencies_ms[:4096]

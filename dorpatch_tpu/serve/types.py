@@ -40,9 +40,13 @@ class PredictResult:
     latency_ms: float
     bucket: int          # padded batch size the request rode in
     batch_images: int    # real (unpadded) images in that batch
+    certify_forwards: Optional[int] = None
+    # ^ masked forwards this image's certification executed across the
+    #   whole defense bank (the pruned scheduler's per-image cost; None
+    #   only for responses predating forward accounting)
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "status": self.status,
             "prediction": self.prediction,
             "certified": self.certified,
@@ -52,6 +56,9 @@ class PredictResult:
             "bucket": self.bucket,
             "batch_images": self.batch_images,
         }
+        if self.certify_forwards is not None:
+            out["certify_forwards"] = self.certify_forwards
+        return out
 
 
 @dataclasses.dataclass(frozen=True)

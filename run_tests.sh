@@ -50,3 +50,13 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   || { echo "serve smoke: report missing serve section"; exit 1; }
 rm -rf "$SERVE_SMOKE"
 echo "serve loadgen smoke: OK"
+# Smoke: pruned double-masking certification — the same seeded stub batch
+# through the exhaustive oracle (--prune off) and the production two-phase
+# schedule must yield bit-identical verdicts while the pruned run executes
+# strictly fewer masked forwards (tools/certify_prune_smoke.py exits
+# non-zero and lists the violations otherwise).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/certify_prune_smoke.py \
+  | grep -q '"parity": true' \
+  || { echo "certify-prune smoke: parity/forward-count violation"; exit 1; }
+echo "certify prune smoke: OK"

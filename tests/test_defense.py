@@ -1,6 +1,8 @@
 """PatchCleanser certifier tests: randomized decision-logic property tests
-against an independent loop-based oracle, plus stub-model end-to-end coverage
-of the certified / second-round-recovery / majority branches (SURVEY.md §4)."""
+against an independent loop-based oracle, stub-model end-to-end coverage
+of the certified / second-round-recovery / majority branches (SURVEY.md §4),
+and pruned-vs-exhaustive parity/forward-count/zero-recompile coverage of
+the two-phase double-masking scheduler (`DefenseConfig.prune`)."""
 
 import numpy as np
 import pytest
@@ -11,6 +13,7 @@ import jax.numpy as jnp
 from dorpatch_tpu import masks as masks_lib
 from dorpatch_tpu.config import DefenseConfig
 from dorpatch_tpu.defense import (
+    UNEVALUATED,
     PatchCleanser,
     build_defenses,
     double_masking_verdict,
@@ -243,3 +246,181 @@ def test_collect_aggregates(stub_certifier):
     assert pc.result.predictions_1.shape == (3, 36)
     pc.reset()
     assert pc.result is None
+
+
+# ---------- pruned two-phase scheduling (DefenseConfig.prune) ----------
+
+PRUNE_IMG = 32
+PRUNE_CLASSES = 3
+
+
+def _trigger_stub(params, x):
+    """Weightless 3-class trigger detector over the 36-mask family of
+    `geometry(32, 0.1)` (stride 4, window 13): each 4x4 trigger sits at an
+    offset where every mask either fully covers it or misses it, so the
+    masked-prediction tables are exactly constructible. Priority encoding
+    (bright beats dark) yields all four verdict classes — see
+    `_prune_batch`."""
+    t1 = x[:, 4:8, 4:8, :].mean(axis=(1, 2, 3)) > 0.8        # bright, NW
+    t2 = x[:, 24:28, 24:28, :].mean(axis=(1, 2, 3)) > 0.8    # bright, SE
+    t3 = x[:, 4:8, 24:28, :].mean(axis=(1, 2, 3)) < 0.2      # dark, NE
+    cls = jnp.where(t1 | t2, 1, jnp.where(t3, 2, 0))
+    return jax.nn.one_hot(cls, PRUNE_CLASSES)
+
+
+def _prune_batch():
+    """Four images, one per verdict class: [0] gray = unanimous certified;
+    [1] one bright trigger = first-round disagreement recovered by the
+    minority row; [2] two bright triggers no single mask can co-occlude =
+    unanimous but a double mask kills the certificate; [3] bright + dark
+    trigger = disagreement whose minority row is broken (majority stands)."""
+    imgs = np.full((4, PRUNE_IMG, PRUNE_IMG, 3), 0.5, np.float32)
+    imgs[1, 4:8, 4:8] = 1.0
+    imgs[2, 4:8, 4:8] = 1.0
+    imgs[2, 24:28, 24:28] = 1.0
+    imgs[3, 4:8, 4:8] = 1.0
+    imgs[3, 4:8, 24:28] = 0.0
+    return jnp.asarray(imgs)
+
+
+def _prune_pair(prune="exact"):
+    spec = masks_lib.geometry(PRUNE_IMG, 0.1)
+    oracle = PatchCleanser(_trigger_stub, spec,
+                           DefenseConfig(ratios=(0.1,), prune="off"))
+    pruned = PatchCleanser(_trigger_stub, spec,
+                           DefenseConfig(ratios=(0.1,), prune=prune))
+    return oracle, pruned
+
+
+@pytest.mark.parametrize("bucket_sizes", [None, (1, 8)])
+def test_pruned_parity_all_verdict_classes(bucket_sizes):
+    """Pruned verdicts are bit-identical to the exhaustive oracle on a
+    batch covering every verdict class, and every second-round entry the
+    pruned path DID evaluate matches the exhaustive table."""
+    oracle, pruned = _prune_pair()
+    x = _prune_batch()
+    want = oracle.robust_predict(None, x, PRUNE_CLASSES)
+    got = pruned.robust_predict(None, x, PRUNE_CLASSES,
+                                bucket_sizes=bucket_sizes)
+    # the batch really covers all four classes
+    assert [(w.certification,
+             bool((w.preds_1 == w.preds_1[0]).all())) for w in want] == \
+        [(True, True), (False, False), (False, True), (False, False)]
+    assert want[1].prediction != want[3].prediction  # recovered vs majority
+    for i, (w, g) in enumerate(zip(want, got)):
+        assert (g.prediction, g.certification) == \
+            (w.prediction, w.certification), f"image {i}"
+        np.testing.assert_array_equal(g.preds_1, w.preds_1)
+        evaluated = g.preds_2 != UNEVALUATED
+        np.testing.assert_array_equal(g.preds_2[evaluated],
+                                      w.preds_2[evaluated])
+
+
+def test_pruned_forward_counts():
+    """Forward accounting: the oracle always charges M + P; pruned
+    unanimous images keep the full audit (bit-identical certificates),
+    disagreeing images pay M + k*M for their k minority rows."""
+    oracle, pruned = _prune_pair()
+    x = _prune_batch()
+    want = oracle.robust_predict(None, x, PRUNE_CLASSES)
+    got = pruned.robust_predict(None, x, PRUNE_CLASSES, bucket_sizes=(1, 8))
+    m, p = pruned.num_first, pruned.num_second
+    assert all(w.forwards == m + p for w in want)
+    for w, g in zip(want, got):
+        k = int((w.preds_1 != np.bincount(
+            w.preds_1, minlength=PRUNE_CLASSES).argmax()).sum())
+        assert g.forwards == (m + p if k == 0 else m + k * m)
+        assert g.forwards <= w.forwards
+
+
+def test_pruned_consensus_unanimous_early_exit():
+    """prune="consensus": first-round-unanimous images exit at EXACTLY the
+    first-round forward count (36 for this family) with a consensus-only
+    certificate; disagreeing images are untouched (same records as
+    "exact")."""
+    _, exact = _prune_pair("exact")
+    _, consensus = _prune_pair("consensus")
+    x = _prune_batch()
+    we = exact.robust_predict(None, x, PRUNE_CLASSES)
+    wc = consensus.robust_predict(None, x, PRUNE_CLASSES)
+    m = consensus.num_first
+    for i in (0, 2):  # the unanimous images
+        assert wc[i].forwards == m
+        assert wc[i].certification is True
+        assert (wc[i].preds_2 == UNEVALUATED).all()
+    # image 2's pair audit fails -> "consensus" certifies what "exact"
+    # (and the oracle) refuse: the documented weaker-certificate trade
+    assert we[2].certification is False
+    for i in (1, 3):  # disagreeing images: identical to "exact"
+        assert wc[i].forwards == we[i].forwards
+        assert (wc[i].prediction, wc[i].certification) == \
+            (we[i].prediction, we[i].certification)
+
+
+def test_pruned_dense_disagreement_routes_to_pairs():
+    """When an image's minority is so large that its rows would cost more
+    than the pair table (k*M >= P), the scheduler routes it through the
+    pair program: pruning never exceeds the exhaustive forward count."""
+    def chaotic(params, x):
+        s = x.mean(axis=(1, 2, 3))  # any occlusion flips the class
+        return jax.nn.one_hot((s * 997).astype(jnp.int32) % PRUNE_CLASSES,
+                              PRUNE_CLASSES)
+
+    spec = masks_lib.geometry(PRUNE_IMG, 0.1)
+    oracle = PatchCleanser(chaotic, spec,
+                           DefenseConfig(ratios=(0.1,), prune="off"))
+    pruned = PatchCleanser(chaotic, spec,
+                           DefenseConfig(ratios=(0.1,), prune="exact"))
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.uniform(0, 1, (2, PRUNE_IMG, PRUNE_IMG, 3))
+                    .astype(np.float32))
+    want = oracle.robust_predict(None, x, PRUNE_CLASSES)
+    got = pruned.robust_predict(None, x, PRUNE_CLASSES, bucket_sizes=(1, 4))
+    m, p = pruned.num_first, pruned.num_second
+    for w, g in zip(want, got):
+        k = int((w.preds_1 != np.bincount(
+            w.preds_1, minlength=PRUNE_CLASSES).argmax()).sum())
+        assert k * m >= p, "fixture lost its dense disagreement"
+        assert (g.prediction, g.certification) == \
+            (w.prediction, w.certification)
+        assert g.forwards == m + p  # routed through the pair program
+
+
+def test_pruned_zero_recompile_ragged_sizes():
+    """After `warm_pruned`, ragged batch sizes (and the ragged phase-2
+    worklists they induce) share the per-bucket compiled programs: trace
+    counts are identical before and after traffic, verified under the
+    ARMED recompile watchdog (any over-budget retrace raises)."""
+    from dorpatch_tpu.analysis.sanitize import Sanitizer
+
+    spec = masks_lib.geometry(PRUNE_IMG, 0.1)
+    buckets = (1, 4, 8)
+    pc = PatchCleanser(_trigger_stub, spec,
+                       DefenseConfig(ratios=(0.1,), prune="exact"),
+                       recompile_budget=len(buckets))
+    pc.warm_pruned(None, buckets)
+    warm = pc.pruned_trace_counts()
+    assert warm[f"defense.phase1.r{spec.patch_ratio}"] == len(buckets)
+    assert warm[f"defense.rows.r{spec.patch_ratio}"] == \
+        len(pc.row_bucket_sizes)
+    base = _prune_batch()
+    with Sanitizer(debug_nans=False, log_compiles=False):
+        for n in (1, 2, 3, 4, 5, 8):
+            idx = [i % 4 for i in range(n)]  # mixed verdict classes
+            recs = pc.robust_predict(None, base[np.asarray(idx)],
+                                     PRUNE_CLASSES, bucket_sizes=buckets)
+            assert len(recs) == n
+    assert pc.pruned_trace_counts() == warm
+
+
+def test_resolved_prune_validates_and_forces_off():
+    pc, _ = _prune_pair()
+    assert pc.resolved_prune() == "off"
+    assert pc.resolved_prune("exact") == "exact"
+    with pytest.raises(ValueError):
+        pc.resolved_prune("fast")
+    # n_patch != 1 families have no pruned programs: always exhaustive
+    multi = PatchCleanser(
+        _trigger_stub, masks_lib.geometry(PRUNE_IMG, 0.1, n_patch=2),
+        DefenseConfig(ratios=(0.1,), prune="exact"))
+    assert multi.resolved_prune() == "off"

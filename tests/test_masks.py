@@ -105,6 +105,22 @@ def test_pair_index():
     np.testing.assert_array_equal(idx, np.arange(len(ii)))
 
 
+def test_second_round_table_indices():
+    """Row i of the combined-table grid is first-round mask i's second-round
+    mask set: the single mask itself on the diagonal (masking idempotence),
+    the {i, j} pair at `n + pair_index` off it — symmetric by construction."""
+    n = 6
+    grid = masks.second_round_table_indices(n)
+    assert grid.shape == (n, n)
+    np.testing.assert_array_equal(grid, grid.T)
+    np.testing.assert_array_equal(np.diag(grid), np.arange(n))
+    ii, jj = np.triu_indices(n, k=1)
+    np.testing.assert_array_equal(
+        grid[ii, jj], n + masks.pair_index(n, ii, jj))
+    # every combined-table index lands in [0, n + C(n,2))
+    assert grid.min() == 0 and grid.max() == n + n * (n - 1) // 2 - 1
+
+
 def test_pad_rects_is_noop_on_mask():
     spec = masks.geometry(64, 0.06)
     singles, _ = masks.mask_sets(spec)

@@ -101,17 +101,31 @@ def test_sharded_defense_matches_unsharded():
     x = jax.random.uniform(jax.random.PRNGKey(7), (3, 32, 32, 3))
 
     ref = build_defenses(_toy_apply, 32, dcfg)[0]
-    ref_records = ref.robust_predict(None, x, 4)
+    # full-table comparison needs the exhaustive schedule; the meshed path
+    # always runs it (resolved_prune forces "off" under a mesh)
+    ref_records = ref.robust_predict(None, x, 4, prune="off")
 
     mesh = make_mesh(1, 8)
     sh = make_sharded_defenses(_toy_apply, 32, mesh, dcfg)[0]
     sh_records = sh.robust_predict(None, jax.device_put(x, parallel.replicated(mesh)), 4)
+    assert sh.resolved_prune() == "off"
 
     for a, b in zip(ref_records, sh_records):
         assert a.prediction == b.prediction
         assert a.certification == b.certification
         np.testing.assert_array_equal(a.preds_1, b.preds_1)
         np.testing.assert_array_equal(a.preds_2, b.preds_2)
+
+    # the pruned default agrees with the meshed verdicts wherever it
+    # evaluated the table (bit-identical verdicts, sparse preds_2)
+    pruned_records = ref.robust_predict(None, x, 4)
+    for a, b in zip(pruned_records, sh_records):
+        assert a.prediction == b.prediction
+        assert a.certification == b.certification
+        np.testing.assert_array_equal(a.preds_1, b.preds_1)
+        evaluated = a.preds_2 >= 0
+        np.testing.assert_array_equal(a.preds_2[evaluated],
+                                      np.asarray(b.preds_2)[evaluated])
 
 
 @pytest.mark.slow

@@ -83,6 +83,45 @@ def test_bucket_batch_rounds_up():
         data_lib.bucket_batch(33, buckets)
 
 
+def test_bucket_plan_greedy_cover():
+    """Greedy decomposition for ragged worklists (the pruned certifier's
+    phase-2 dispatch): full buckets largest-first, padding confined to one
+    tail call, `n` beyond the largest bucket allowed."""
+    assert data_lib.bucket_plan(0, (1, 8, 32)) == []
+    assert data_lib.bucket_plan(8, (1, 8, 32)) == [(0, 8, 8)]
+    # the motivating case: 34 rows run as 32 + a padded 8, not one 128
+    assert data_lib.bucket_plan(34, (1, 8, 32, 128)) == \
+        [(0, 32, 32), (32, 2, 8)]
+    assert data_lib.bucket_plan(34, (8, 32)) == [(0, 32, 32), (32, 2, 8)]
+    assert data_lib.bucket_plan(5, (8, 32)) == [(0, 5, 8)]
+    # the smallest rung never shreds a remainder into batch-1 dispatches:
+    # below the next rung the remainder ships as ONE padded tail call
+    assert data_lib.bucket_plan(7, (1, 8, 32)) == [(0, 7, 8)]
+    assert data_lib.bucket_plan(9, (1, 8, 32)) == [(0, 8, 8), (8, 1, 1)]
+    # slot ties go to the single padded call: one dispatch beats four
+    assert data_lib.bucket_plan(31, (1, 8, 32)) == [(0, 31, 32)]
+    # n > max(buckets): more full-bucket calls, unlike bucket_batch
+    assert data_lib.bucket_plan(96, (8, 32)) == \
+        [(0, 32, 32), (32, 32, 32), (64, 32, 32)]
+
+
+def test_bucket_plan_properties_randomized():
+    rng = np.random.default_rng(11)
+    ladders = [(1, 8, 32), (8, 32), (4,), (1, 2, 4, 128)]
+    for _ in range(200):
+        buckets = ladders[rng.integers(0, len(ladders))]
+        n = int(rng.integers(0, 300))
+        plan = data_lib.bucket_plan(n, buckets)
+        assert sum(c for _, c, _ in plan) == n          # exact coverage
+        pos = 0
+        for off, cnt, bucket in plan:
+            assert off == pos and 0 < cnt <= bucket     # contiguous
+            assert bucket in buckets                    # compiled shapes only
+            pos += cnt
+        # padding only in the final call, bounded by its bucket
+        assert all(c == b for _, c, b in plan[:-1])
+
+
 # ---------- defense.robust_predict bucketing (satellite) ----------
 
 @pytest.fixture(scope="module")
@@ -109,9 +148,17 @@ def test_bucketed_robust_predict_shares_traces():
     pc = PatchCleanser(stub_apply, masks_lib.geometry(IMG, 0.1))
     for b in (2, 3, 4):  # all round up to the same bucket of 4
         recs = pc.robust_predict(None, jnp.asarray(make_images(b, seed=b)),
-                                 N_CLASSES, bucket_sizes=(4, 8))
+                                 N_CLASSES, bucket_sizes=(4, 8),
+                                 prune="off")
         assert len(recs) == b
     assert int(pc._predict._cache_size()) == 1
+    # the pruned schedule (the default) buckets every phase the same way:
+    # ragged batches inside one bucket share ONE phase-1 program
+    for b in (2, 3, 4):
+        recs = pc.robust_predict(None, jnp.asarray(make_images(b, seed=b)),
+                                 N_CLASSES, bucket_sizes=(4, 8))
+        assert len(recs) == b
+    assert int(pc._phase1._cache_size()) == 1
 
 
 # ---------- micro-batcher flush semantics ----------
@@ -283,9 +330,20 @@ def test_serve_e2e_zero_recompile_correct_verdicts_reported(tmp_path, capsys):
     images = make_images(52, seed=7)
     svc = make_service(tmp_path)
     with svc:
+        assert svc.prune == "exact"
         warm = svc.trace_counts()
-        # one program per shape bucket, compiled at warmup
-        assert set(warm.values()) == {len(svc.bucket_sizes)}
+        # one program per shape bucket, compiled at warmup: the clean
+        # forward plus the pruned certifier's phase-1/pair programs per
+        # image bucket and its row program per row bucket (the exhaustive
+        # program exists but never compiles under a pruned schedule)
+        nb = len(svc.bucket_sizes)
+        assert warm["serve.clean_predict"] == nb
+        for d in svc.defenses:
+            r = d.spec.patch_ratio
+            assert warm[f"defense.predict.r{r}"] == 0
+            assert warm[f"defense.phase1.r{r}"] == nb
+            assert warm[f"defense.pairs.r{r}"] == nb
+            assert warm[f"defense.rows.r{r}"] == len(d.row_bucket_sizes)
 
         results = []
         # mixed batch sizes: lone requests (bucket 1), small bursts
@@ -311,10 +369,19 @@ def test_serve_e2e_zero_recompile_correct_verdicts_reported(tmp_path, capsys):
         assert r.clean_prediction == int(clean_want[i]), f"request {i}"
         assert r.verdicts[0].ratio == 0.1
         assert r.latency_ms >= 0.0 and r.bucket in svc.bucket_sizes
+        # per-request certify cost is reported and never exceeds the
+        # exhaustive sweep; it matches the direct pruned certifier's count
+        assert r.certify_forwards == w.forwards, f"request {i}"
+        assert 0 < r.certify_forwards <= ref.num_forwards_exhaustive
 
     assert stats["completed"] == 52 and stats["rejected"] == 0
     assert stats["latency_ms"]["p50"] is not None
     assert 0.0 < stats["occupancy"] <= 1.0
+    cf = stats["certify_forwards"]
+    assert stats["prune"] == "exact"
+    assert cf["total"] == sum(w.forwards for w in want)
+    assert cf["per_request"] > 0
+    assert cf["prune_rate"] is not None and 0.0 <= cf["prune_rate"] < 1.0
 
     # the results dir carries the standard telemetry contract
     rd = str(tmp_path / "serve")

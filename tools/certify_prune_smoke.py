@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Certify-prune smoke: pruned vs exhaustive double-masking on a seeded
+stub batch (CI gate, `run_tests.sh`).
+
+Runs the same mixed batch — one provably-unanimous gray image plus seeded
+random images — through `defense.robust_predict` with `prune="off"` (the
+exhaustive 666-forward oracle) and `prune="exact"` (the production
+two-phase schedule), then asserts:
+
+- verdict parity: (prediction, certification) bit-identical per image,
+  and the first-round tables equal;
+- every double-masked entry the pruned path DID evaluate matches the
+  exhaustive table;
+- the pruned path executed strictly fewer masked forwards in total.
+
+Prints ONE JSON line: {"metric": "certify_prune_smoke", "parity": true,
+"forwards": N, "forwards_exhaustive": N, "prune_rate": r, ...}; exits
+non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from dorpatch_tpu import masks as masks_lib
+    from dorpatch_tpu.config import DefenseConfig
+    from dorpatch_tpu.defense import PatchCleanser
+
+    img, n_classes = 32, 2
+
+    def stub(params, x):
+        # weightless trigger detector: class 1 iff the 4x4 region at
+        # (20:24, 20:24) is mostly bright — only masks occluding the whole
+        # trigger flip it, so those masks form a small, genuine
+        # first-round minority (the pruned second round's target shape)
+        score = x[:, 20:24, 20:24, :].mean(axis=(1, 2, 3))
+        return jnp.stack([0.7 - score, score - 0.7], axis=-1)
+
+    rng = np.random.default_rng(1234)
+    imgs = np.full((6, img, img, 3), 0.2, np.float32)
+    imgs += rng.uniform(0.0, 0.05, imgs.shape).astype(np.float32)
+    imgs[0] = 0.5  # gray: masking with the gray fill is an identity ->
+    #                provably first-round unanimous (and certified)
+    imgs[3, 20:24, 20:24, :] = 1.0  # planted triggers: first-round
+    imgs[4, 20:24, 20:24, :] = 1.0  # disagreement -> pruned second round
+    x = jnp.asarray(imgs)
+
+    spec = masks_lib.geometry(img, 0.1)
+    oracle = PatchCleanser(stub, spec,
+                           DefenseConfig(ratios=(0.1,), prune="off"))
+    pruned = PatchCleanser(stub, spec,
+                           DefenseConfig(ratios=(0.1,), prune="exact"))
+    want = oracle.robust_predict(None, x, n_classes)
+    got = pruned.robust_predict(None, x, n_classes, bucket_sizes=(1, 8))
+
+    failures = []
+    for i, (w, g) in enumerate(zip(want, got)):
+        if (w.prediction, w.certification) != (g.prediction,
+                                               g.certification):
+            failures.append(f"image {i}: verdict "
+                            f"({w.prediction}, {w.certification}) != "
+                            f"({g.prediction}, {g.certification})")
+        if not np.array_equal(w.preds_1, g.preds_1):
+            failures.append(f"image {i}: first-round tables differ")
+        evaluated = g.preds_2 >= 0
+        if not np.array_equal(w.preds_2[evaluated], g.preds_2[evaluated]):
+            failures.append(f"image {i}: evaluated second-round entries "
+                            "differ from the exhaustive table")
+
+    fwd = sum(r.forwards for r in got)
+    exhaustive = sum(r.forwards for r in want)
+    if not fwd < exhaustive:
+        failures.append(f"no pruning: executed {fwd} vs "
+                        f"exhaustive {exhaustive}")
+    if not any((r.preds_1 == r.preds_1[0]).all() for r in got):
+        failures.append("smoke batch lost its unanimous image")
+
+    print(json.dumps({
+        "metric": "certify_prune_smoke",
+        "parity": not failures,
+        "images": len(got),
+        "forwards": int(fwd),
+        "forwards_exhaustive": int(exhaustive),
+        "prune_rate": round(1.0 - fwd / exhaustive, 4),
+        "failures": failures,
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
