@@ -48,6 +48,10 @@ ResNetV2 victims: "auto" = fused Pallas kernel on single-chip TPU, "flax" =
 XLA path — see ops/fused_gn.py), BENCH_PEAK_TFLOPS, BENCH_JAX_TIMEOUT (seconds, default 1800 —
 first-time Mosaic kernel compiles through the remote tunnel can add many
 minutes),
+BENCH_INCR (certify mode: off|on|ab — mask-aware incremental forwards; "ab"
+times the incremental engine vs the PR 5 pruned-only path on the same batch,
+asserts parity per the family's exactness contract, and prints incr_speedup
+plus forward_equivalents_per_image — see `_certify_bench`),
 BENCH_TORCH_TIMEOUT (default 600), BENCH_TOTAL_BUDGET (seconds, default
 3000 — a hard wall budget across ALL children; every child's timeout is
 clipped so the orchestrator always prints its JSON line before an outer
@@ -303,7 +307,23 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
     so masked predictions disagree, as they do on the eval pipeline's
     adversarial inputs (the workload pruning targets); the other half
     stays benign. Per-image executed forwards and the prune rate come
-    from the records' own accounting."""
+    from the records' own accounting.
+
+    BENCH_INCR selects the mask-aware incremental forwards
+    (DefenseConfig.incremental; "off" default): "on" runs the family's
+    resolved engine (token-pruned ViT / masked-stem conv); "ab" times the
+    incremental path vs the PR 5 pruned-only path on the same batch,
+    asserts parity — bit-exact for the exact-contract families (stem); for
+    the tolerance-contracted token family every verdict mismatch must have
+    been margin-flagged (its min evaluated top-2 logit gap below
+    DefenseConfig.incremental_margin, i.e. the escalation signal
+    token-exact acts on caught it) — and prints `incr_speedup` plus
+    `forward_equivalents_per_image`, the mandatory first-round sweep's
+    per-image cost in full-forward units (36.0 un-pruned; every certified
+    image pays this floor). `forward_equivalents_total_per_image` is the
+    whole certify's fractional cost, and MFU credits fractional forwards.
+    Incremental engines run the f32 params path (bf16 requests fall back,
+    logged)."""
     import jax
     import jax.numpy as jnp
 
@@ -313,9 +333,14 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
     from dorpatch_tpu.models import get_model
 
     prune = os.environ.get("BENCH_PRUNE") or "exact"
+    incr = os.environ.get("BENCH_INCR") or "off"
     victim = get_model(dataset, arch, img_size=img,
                        gn_impl=os.environ.get("BENCH_GN") or "auto")
     apply_fn = victim.apply
+    if dtype == "bfloat16" and incr != "off":
+        log("BENCH_INCR: incremental engines run the f32 params path; "
+            "timing f32 for every mode")
+        dtype = "float32"
     if dtype == "bfloat16":
         params16 = jax.tree_util.tree_map(
             lambda a: a.astype(jnp.bfloat16)
@@ -326,27 +351,36 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
             return victim.apply(params16, xx.astype(jnp.bfloat16)).astype(
                 jnp.float32)
 
-    def make_defense(mode):
+    def make_defense(mode, incremental="off"):
         return build_defenses(
             apply_fn, img, DefenseConfig(ratios=(0.06,), chunk_size=128,
-                                         prune=mode))[0]
+                                         prune=mode,
+                                         incremental=incremental),
+            incremental=victim.incremental if incremental != "off"
+            else None)[0]
 
     key = jax.random.PRNGKey(0)
     x = jax.random.uniform(key, (batch, img, img, 3))
     q = max(4, img // 8)
     x = x.at[batch // 2:, :q, :q, :].set(1.0)  # the disagreement inducer
-    buckets = data_lib.batch_buckets(batch)
+    # power-of-two ladder (denser than the serving default): the measured
+    # quantity is the certify schedule, not bucket-padding luck — with the
+    # sparse (1, 8) ladder a 2-image pair worklist pads 4x while a 1-image
+    # one rides bucket 1, and that noise can dwarf the path under test.
+    # Both A/B sides use the same ladder, so comparisons stay fair.
+    buckets = tuple(sorted({2 ** i for i in range(max(1, batch.bit_length() - 1))}
+                           | {1, batch}))
 
     from dorpatch_tpu import observe
 
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
 
-    def time_mode(mode, xx):
-        d = make_defense(mode)
+    def time_mode(mode, xx, incremental="off"):
+        d = make_defense(mode, incremental)
         t0 = time.perf_counter()
         d.robust_predict(victim.params, xx, victim.num_classes,
                          bucket_sizes=buckets)
-        log(f"[{mode}] compile+first certify: "
+        log(f"[{mode}/incr={incremental}] compile+first certify: "
             f"{time.perf_counter() - t0:.1f}s")
         for i in range(warmup):
             t0 = time.perf_counter()
@@ -354,7 +388,8 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
             xx = xx * 0.999 + 0.0005
             d.robust_predict(victim.params, xx, victim.num_classes,
                              bucket_sizes=buckets)
-            log(f"[{mode}] warmup call {i}: {time.perf_counter() - t0:.2f}s")
+            log(f"[{mode}/incr={incremental}] warmup call {i}: "
+                f"{time.perf_counter() - t0:.2f}s")
         timer = observe.StepTimer()
         recs = None
         for _ in range(reps):
@@ -391,22 +426,89 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
             "parity": mismatches == 0,
             "parity_mismatches": mismatches,
         })
+    elif incr == "ab":
+        # incremental A/B rides the production pruned schedule on both
+        # sides: PR 5's pruned-only path vs the same schedule with the
+        # family engine's incremental forwards. For ViT families the
+        # timed side is the RAW "token" engine — the production default
+        # ("token-exact") adds margin-gated escalation whose cost depends
+        # on the victim's margin distribution (the bench's random-init
+        # victim is the documented escalate-everything worst case), and
+        # its exactness mechanism is covered by the margin-flag assertion
+        # below plus the token-exact parity fixtures in tests.
+        base_prune = "exact" if prune == "off" else prune
+        kind = getattr(victim.incremental, "kind", None)
+        if kind is None:
+            raise AssertionError(
+                f"BENCH_INCR=ab but arch {arch!r} resolved no incremental "
+                "engine — pick a ViT/conv family")
+        d_off, x_final, dt_off, recs_off = time_mode(base_prune, x)
+        d, _, dt, recs = time_mode(
+            base_prune, x,
+            incremental="token" if kind == "token" else "auto")
+        incr_mode = d.resolved_incremental(
+            "token" if kind == "token" else "auto")
+        mism = [i for i, (a, b) in enumerate(zip(recs_off, recs))
+                if (a.prediction, a.certification) != (b.prediction,
+                                                       b.certification)]
+        if incr_mode == "stem":
+            # the stem fold is algebraically exact: same hard-fail terms
+            # as the prune A/B
+            if mism and jax.default_backend() == "cpu" \
+                    and dtype == "float32":
+                raise AssertionError(
+                    f"stem-fold verdict parity broke on {len(mism)} "
+                    f"image(s) at f32 on cpu — a fold bug, not numerics")
+        else:
+            # token parity is tolerance-contracted: every mismatch must
+            # have been margin-flagged (min evaluated top-2 logit gap
+            # below incremental_margin — the signal "token-exact" uses to
+            # escalate; read off the timed run's own pending). A
+            # high-margin mismatch means drift exceeded the documented
+            # tolerance: fail.
+            tol = d.config.incremental_margin
+            unflagged = [i for i in mism
+                         if d.last_min_margin[i] >= tol]
+            if unflagged:
+                raise AssertionError(
+                    f"token drift flipped {len(unflagged)} verdict(s) at "
+                    f"margins >= {tol} — tolerance contract violated")
+        prune_stats.update({
+            "incr": incr_mode,
+            "ips_pruned_only": round(batch / dt_off, 4),
+            "incr_speedup": round(dt_off / dt, 3),
+            "parity": not mism,
+            "parity_mismatches": len(mism),
+        })
+    elif incr == "on":
+        d, x_final, dt, recs = time_mode(prune, x, incremental="auto")
+        prune_stats["incr"] = d.resolved_incremental()
     else:
         d, x_final, dt, recs = time_mode(prune, x)
     fwd = [max(0, r.forwards) for r in recs]
+    fe = [max(0.0, r.forward_equivalents) for r in recs]
     prune_stats.update({
         "forwards_per_image": round(sum(fwd) / len(fwd), 1),
+        # the mandatory first-round sweep's per-image cost in full-forward
+        # units (the acceptance headline: 36.0 un-pruned, the token
+        # engine's fraction of that under BENCH_INCR) and the whole
+        # certify's fractional cost
+        "forward_equivalents_per_image": round(
+            d.first_round_forward_equivalents, 2),
+        "forward_equivalents_total_per_image": round(sum(fe) / len(fe), 2),
         "prune_rate": round(
             1.0 - sum(fwd) / (len(fwd) * d.num_forwards_exhaustive), 4),
     })
 
     # certify-mode MFU through the shared observe.StepTimer.summary formula:
     # forward-only FLOPs (XLA's own count at the chunked sweep's batch
-    # shape) x EXECUTED masked-forward rate over the chip peak (pruned
-    # runs are credited only the forwards they dispatched); same guard as
-    # the attack child — unavailable cost model just omits it
+    # shape) x EXECUTED masked-forward rate over the chip peak — pruned
+    # runs are credited only the forwards they dispatched, incremental
+    # runs only the FRACTION of each forward they recomputed
+    # (forward_equivalents); same guard as the attack child — unavailable
+    # cost model just omits it
     n_masks = d.num_forwards_exhaustive
-    executed = sum(fwd)
+    executed = sum(fe)
     mfu = None
     try:
         chunk = min(d.config.chunk_size, n_masks)
@@ -606,6 +708,25 @@ def main() -> None:
                           "error": f"unknown BENCH_PRUNE={bp!r} (use 'off', "
                                    "'exact', 'consensus' or 'ab')"}))
         return
+    bi = os.environ.get("BENCH_INCR") or "off"
+    if bi not in ("off", "on", "ab"):
+        print(json.dumps({"metric": err_metric, "value": 0.0,
+                          "unit": "images/sec", "vs_baseline": 0.0,
+                          "error": f"unknown BENCH_INCR={bi!r} (use 'off', "
+                                   "'on' or 'ab')"}))
+        return
+    if bp == "ab" and bi != "off":
+        # the prune A/B branch runs both sides with the incremental engine
+        # off; accepting BENCH_INCR=on|ab here would silently report
+        # pruned-only numbers as if the engine were active
+        print(json.dumps({"metric": err_metric, "value": 0.0,
+                          "unit": "images/sec", "vs_baseline": 0.0,
+                          "error": "BENCH_PRUNE=ab measures the pruned-vs-"
+                                   "exhaustive axis with the incremental "
+                                   "engine off; set BENCH_INCR=off (or "
+                                   "drop BENCH_PRUNE=ab to measure "
+                                   "BENCH_INCR)"}))
+        return
     eot = int(os.environ.get("BENCH_EOT", "128"))
     jax_timeout = int(os.environ.get("BENCH_JAX_TIMEOUT", "1800"))
     torch_timeout = int(os.environ.get("BENCH_TORCH_TIMEOUT", "600"))
@@ -705,7 +826,9 @@ def main() -> None:
               "masked_images_per_sec", "masks_per_image", "masked_fwd_per_sec",
               "seconds_per_batch", "backend", "prune", "forwards_per_image",
               "prune_rate", "ips_exhaustive", "prune_speedup", "parity",
-              "parity_mismatches"):
+              "parity_mismatches", "incr", "incr_speedup", "ips_pruned_only",
+              "forward_equivalents_per_image",
+              "forward_equivalents_total_per_image"):
         if res.get(k) is not None:
             out[k] = res[k]
     if fallback is not None:

@@ -18,9 +18,12 @@ arguments to trace it with. This module supplies both:
 - **Enumeration** — `production_entrypoints()` constructs (without ever
   executing) the programs the production stack compiles: the attack
   stage-0/1 block and sweep programs, the per-radius defense
-  predict/certify tables, the train init/step/eval programs, the jitted
-  model initializer, the serve bucket programs, and (on multi-device
-  hosts) the shard_map'd masked-fill gradient with its mask-axis psum.
+  predict/certify tables, the incremental certify programs (the
+  token-pruned ViT phase1/pairs/rows and the stem-folded conv phase 1,
+  one bank per engine family), the train init/step/eval programs, the
+  jitted model initializer, the serve bucket programs, and (on
+  multi-device hosts) the shard_map'd masked-fill gradient with its
+  mask-axis psum.
   Example args are `ShapeDtypeStruct`s throughout — enumeration costs
   tracing only, no device FLOPs — with the victim scaled to the small
   CIFAR family so the gate stays CPU-cheap while exercising the exact
@@ -265,6 +268,54 @@ def _enumerate_defense(apply_fn, params) -> None:
         register_entrypoint(d._rows, (params_abs, imgs_g, mask_idx))
 
 
+def _enumerate_incremental() -> None:
+    """The mask-aware incremental certify programs (DefenseConfig.
+    incremental): one bank per engine family — the token-pruned ViT
+    programs on the small ViT victim, the stem-folded conv phase 1 on the
+    conv victim — at one representative radius (0.06, shared with the
+    standard bank so the per-radius wrapper names stay covered). The
+    engines' lookup tables are closed-over DEVICE arrays (the params idiom
+    DP203 exempts); registration attaches abstract args only, nothing
+    executes."""
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu.config import DefenseConfig
+    from dorpatch_tpu.defense import build_defenses
+    from dorpatch_tpu.models import registry
+
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    dummy = jax.ShapeDtypeStruct(
+        (1, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    imgs = jax.ShapeDtypeStruct(
+        (AUDIT_BATCH, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    for arch in ("cifar_vit", "cifar_resnet18"):
+        model = registry.build_bare_model(arch, AUDIT_CLASSES)
+        engine = registry.incremental_engine(arch, model, AUDIT_IMG_SIZE)
+
+        def apply(params, images01, _m=model):
+            return _m.apply(params, (images01 - 0.5) / 0.5)
+
+        params_abs = abstractify(jax.eval_shape(model.init, key, dummy))
+        d = build_defenses(apply, AUDIT_IMG_SIZE,
+                           DefenseConfig(ratios=(0.06,), chunk_size=64),
+                           recompile_budget=1, incremental=engine)[0]
+        w = int(d.row_bucket_sizes[0])
+        imgs_g = jax.ShapeDtypeStruct(
+            (w, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+        for name, fn, kind in d.pruned_programs():
+            if kind == "imgs":
+                register_entrypoint(fn, (params_abs, imgs), name=name)
+            elif kind == "rows_sets":
+                sets = jax.ShapeDtypeStruct((w, d.num_first), jnp.int32)
+                register_entrypoint(fn, (params_abs, imgs_g, sets),
+                                    name=name)
+            else:
+                mask_idx = jax.ShapeDtypeStruct((w,), jnp.int32)
+                register_entrypoint(fn, (params_abs, imgs_g, mask_idx),
+                                    name=name)
+
+
 def _enumerate_train() -> None:
     from dorpatch_tpu import train
 
@@ -340,6 +391,7 @@ def production_entrypoints(clear: bool = True) -> List[EntryPoint]:
     with capture_entrypoints():
         _enumerate_attack(apply_fn, params)
         _enumerate_defense(apply_fn, params)
+        _enumerate_incremental()
         _enumerate_train()
         _enumerate_model_init()
         _enumerate_serve(apply_fn, params)

@@ -123,6 +123,27 @@ def build_parser() -> argparse.ArgumentParser:
                    const="off",
                    help="alias for --prune off (the exhaustive parity "
                         "oracle)")
+    p.add_argument("--incremental", default="auto",
+                   choices=["auto", "token", "token-exact", "stem", "off"],
+                   help="mask-aware incremental masked forwards on the "
+                        "pruned certify path: 'auto' (default) picks per "
+                        "family — 'token-exact' for ViT victims "
+                        "(token-pruned forwards over a clean KV cache, "
+                        "per-mask cost ~ mask_tokens/T, plus re-running "
+                        "images whose read entries sit within "
+                        "--incremental-margin of the decision boundary "
+                        "through the exhaustive program, so verdicts stay "
+                        "bit-identical under the documented drift "
+                        "tolerance) or the exact conv masked-stem fold "
+                        "('stem'); plain 'token' opts into "
+                        "tolerance-contracted verdicts with no "
+                        "escalation; 'off' = full masked forwards for "
+                        "every scheduled entry")
+    p.add_argument("--incremental-margin", type=float, default=0.5,
+                   help="token-exact escalation threshold: top-2 logit gap "
+                        "below which an incremental table entry is "
+                        "distrusted and its image re-certified through the "
+                        "exhaustive program")
     # serving (`python -m dorpatch_tpu.serve` reuses this parser)
     p.add_argument("--serve-port", type=int, default=8700,
                    help="HTTP front-end port for the certified-inference "
@@ -198,7 +219,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         attack=attack,
         defense=DefenseConfig(use_pallas=args.use_pallas,
                               n_patch=args.defense_n_patch,
-                              prune=args.prune),
+                              prune=args.prune,
+                              incremental=args.incremental,
+                              incremental_margin=args.incremental_margin),
         serve=ServeConfig(port=args.serve_port,
                           max_batch=args.serve_max_batch,
                           max_queue_depth=args.serve_queue_depth,

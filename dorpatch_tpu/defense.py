@@ -45,6 +45,32 @@ skipped entry is provably unread. Per-image executed-forward counts land in
 unanimous images skip the pair audit (36 forwards total, ~18x): their
 certificate then asserts round-1 consensus only, which is the reference's
 early-exit *inference* answer but a strictly weaker certificate — opt-in.
+
+Incremental masked forwards (`DefenseConfig.incremental`, default "auto"):
+pruning decides *which* table entries run; the incremental engines make
+each surviving entry cheaper. Every scheduled entry's mask covers a small
+contiguous window, so most of the victim's activations are identical to
+the clean image's across all masks. The pruned-path programs
+(phase1/pairs/rows) are swapped for engine-backed twins that share a
+per-image clean-activation cache:
+
+- ViT families ("token", `models.vit.TokenPrunedViT`): the clean per-block
+  token activations are computed once; each masked entry recomputes only
+  the mask-touched patch tokens (+ cls) with attention reading the clean
+  KV cache for untouched positions — per-entry cost ~ dirty_tokens/(T+1),
+  the fraction recorded in `PatchCleanserRecord.forward_equivalents`.
+  Exact for each block given its inputs (in particular the final-block
+  readout) but untouched tokens keep clean activations, so logits carry a
+  small bounded drift; programs therefore also return top-2 logit margins,
+  and "token-exact" re-runs any image whose evaluated entries come within
+  `incremental_margin` of the argmax boundary through the exhaustive
+  program — verdicts then stay bit-identical whenever the drift stays
+  below that documented tolerance.
+- Conv families ("stem", `ops.stem_fold.StemFoldEngine`): the bias-free
+  stem conv is linear, so the 36-mask first round folds `apply_masks`
+  into per-mask delta convs over static windows scattered into one shared
+  post-stem cache — algebraically exact, no tolerance; phase 2 keeps the
+  standard programs (pair windows approach the full image).
 """
 
 from __future__ import annotations
@@ -66,6 +92,14 @@ from dorpatch_tpu.config import DefenseConfig
 #: Legal values of `DefenseConfig.prune` (see the module docstring).
 PRUNE_MODES = ("off", "exact", "consensus")
 
+#: Legal values of `DefenseConfig.incremental`: mask-aware incremental
+#: masked forwards riding the pruned dispatch path. "auto" resolves per
+#: victim family ("token" for ViT engines, "stem" for conv engines, "off"
+#: where no engine exists); "token-exact" adds margin-gated escalation to
+#: the exhaustive program so verdicts stay bit-identical whenever the
+#: token path's logit drift stays below `DefenseConfig.incremental_margin`.
+INCREMENTAL_MODES = ("auto", "token", "token-exact", "stem", "off")
+
 #: Sentinel for double-masked table entries the pruned path never evaluated
 #: (provably unread by the verdict); `preds_2` slots hold labels >= 0 only
 #: where a forward actually ran.
@@ -76,15 +110,21 @@ class PatchCleanserRecord(NamedTuple):
     """Per-image verdict (reference `PatchCleanserRecord`, `PatchCleanser.py:121-126`).
 
     `preds_2` entries are `UNEVALUATED` (-1) where the pruned scheduler
-    proved the verdict never reads them. `forwards` counts the masked
-    forwards this image actually executed (bucket-padding waste excluded);
-    -1 marks records written before forward accounting existed."""
+    proved the verdict never reads them. `forwards` counts the masked-table
+    ENTRIES this image actually evaluated (bucket-padding waste excluded);
+    -1 marks records written before forward accounting existed.
+    `forward_equivalents` credits incremental entries fractionally: a
+    token-pruned ViT forward that recomputes S of T+1 tokens costs
+    S/(T+1) of a full forward, so the float is the image's true certify
+    cost in full-forward units (== forwards on non-incremental paths;
+    -1.0 on pre-incremental records)."""
 
     prediction: int
     certification: bool
     preds_1: np.ndarray  # [M] one-masked predictions
     preds_2: np.ndarray  # [P] double-masked predictions
-    forwards: int = -1   # executed masked forwards for this image
+    forwards: int = -1   # evaluated masked-table entries for this image
+    forward_equivalents: float = -1.0  # fractional full-forward cost
 
 
 class PatchCleanserResult:
@@ -321,7 +361,8 @@ class _PrunedPending:
     before any sync, preserving cross-radius overlap on device."""
 
     def __init__(self, pc: "PatchCleanser", params, imgs, n: int,
-                 num_classes: int, bucket_sizes, mode: str):
+                 num_classes: int, bucket_sizes, mode: str,
+                 incremental: str = "off"):
         self.pc = pc
         self.params = params
         self.imgs = imgs           # device, possibly bucket-padded
@@ -329,15 +370,22 @@ class _PrunedPending:
         self.num_classes = num_classes
         self.bucket_sizes = bucket_sizes
         self.mode = mode
-        self.t1 = pc._phase1(params, imgs)     # [B_pad, M], device
+        self.incr = incremental    # resolved incremental mode
+        # phase 1: the incremental programs return (preds, margins); the
+        # standard program returns the bare [B_pad, M] prediction table
+        if incremental != "off":
+            self.t1, self.t1_margins = pc._phase1_incr(params, imgs)
+        else:
+            self.t1, self.t1_margins = pc._phase1(params, imgs), None
         self._scheduled = False
         self.p1 = None
+        self.m1 = None             # [n, M] phase-1 margins (incremental)
         self.majority = None
         self.unanimous = None
         self.pair_idx = np.zeros((0,), np.int64)
         self.row_list = []
-        self.pair_chunks = []      # [(device [bucket, P], offset, count)]
-        self.row_chunks = []       # [(device [wb, M], w_real, entries)]
+        self.pair_chunks = []      # [(device preds/(preds,margins), off, count)]
+        self.row_chunks = []       # [(device preds/(preds,margins), w_real, entries)]
 
     def schedule(self) -> "_PrunedPending":
         """THE one designed host sync of the pruned path: materialize the
@@ -364,6 +412,8 @@ class _PrunedPending:
         # get one derived from their fixed batch size: the pair worklist
         # size varies with the batch's verdict mix, and dispatching at the
         # raw size would recompile the 630-mask program per distinct k.
+        token = self.incr in ("token", "token-exact")
+        pairs_prog = pc._pairs_incr if token else pc._pairs
         if self.pair_idx.size:
             k = int(self.pair_idx.size)
             bs = (self.bucket_sizes if self.bucket_sizes is not None
@@ -373,53 +423,100 @@ class _PrunedPending:
                     jnp.take(self.imgs,
                              jnp.asarray(self.pair_idx[off:off + cnt]),
                              axis=0), bucket)
-                self.pair_chunks.append((pc._pairs(self.params, xu),
+                self.pair_chunks.append((pairs_prog(self.params, xu),
                                          off, cnt))
 
+        grid_full = np.asarray(pc._grid_full)
         for off, w, wb in data_lib.bucket_plan(len(self.row_list),
                                                pc.row_bucket_sizes):
             chunk = self.row_list[off:off + w]
             img_idx = [b for b, _ in chunk] + [chunk[-1][0]] * (wb - w)
             mask_idx = [i for _, i in chunk] + [chunk[-1][1]] * (wb - w)
             xg = jnp.take(self.imgs, jnp.asarray(img_idx), axis=0)
-            t = pc._rows(self.params, xg,
-                         jnp.asarray(mask_idx, dtype=jnp.int32))
+            if token:
+                # the token rows program takes each entry's combined-table
+                # index row (the grid gather happens host-side, where the
+                # first-mask ids live anyway)
+                t = pc._rows_incr(self.params, xg,
+                                  jnp.asarray(grid_full[mask_idx],
+                                              dtype=jnp.int32))
+            else:
+                t = pc._rows(self.params, xg,
+                             jnp.asarray(mask_idx, dtype=jnp.int32))
             self.row_chunks.append((t, w, chunk))
         return self
 
     def finalize(self) -> List[PatchCleanserRecord]:
         """Materialize phase-2 outputs and assemble records (host work;
-        syncs the phase-2 prediction tables)."""
+        syncs the phase-2 prediction tables). Under "token-exact" this is
+        also where escalation happens: any image whose evaluated
+        incremental entries include a top-2 logit margin below
+        `DefenseConfig.incremental_margin` is re-certified through the
+        exhaustive program in one extra bucketed dispatch, so its record —
+        and therefore its verdict — is bit-identical to the oracle."""
         self.schedule()
         pc = self.pc
         m, p = pc.num_first, pc.num_second
         p1, majority, unanimous = self.p1, self.majority, self.unanimous
+        token = self.incr in ("token", "token-exact")
+        if token and self.m1 is None:
+            self.m1 = np.asarray(self.t1_margins)[:self.n]
+
+        def split(t, k):
+            """Materialize one phase-2 chunk: (preds [k, ...], margins)."""
+            if isinstance(t, tuple):
+                return np.asarray(t[0])[:k], np.asarray(t[1])[:k]
+            return np.asarray(t)[:k], None
 
         pair_tables = {}
+        pair_margins = {}
         for t, off, cnt in self.pair_chunks:
-            tbl = np.asarray(t)[:cnt]
+            tbl, mg = split(t, cnt)
             for pos in range(cnt):
-                pair_tables[int(self.pair_idx[off + pos])] = tbl[pos]
+                b = int(self.pair_idx[off + pos])
+                pair_tables[b] = tbl[pos]
+                if mg is not None:
+                    pair_margins[b] = mg[pos]
         rows = {}                      # image -> {mask i -> [M] row}
+        row_margins = {}
         for t, w, chunk in self.row_chunks:
-            tbl = np.asarray(t)[:w]
+            tbl, mg = split(t, w)
             for pos, (b, i) in enumerate(chunk):
                 rows.setdefault(b, {})[i] = tbl[pos]
+                if mg is not None:
+                    row_margins.setdefault(b, {})[i] = mg[pos]
 
+        if self.incr == "off":
+            # standard full forwards even when an engine family was built
+            # (robust_predict(..., incremental="off") on an engine-backed
+            # certifier): fe must equal the entry counts, not the token
+            # fractions the aggregates carry
+            fe_first, fe_pairs = float(m), float(p)
+            fe_rows = np.full((m,), float(m))
+        else:
+            fe_first, fe_pairs = pc._fe_first, pc._fe_pairs
+            fe_rows = pc._fe_rows
         grid = pc._np_grid             # [M, M] into preds_2, diagonal -> 0
         records: List[PatchCleanserRecord] = []
+        min_margin = np.full((self.n,), np.inf)
         for b in range(self.n):
             mj = int(majority[b])
+            if token:
+                min_margin[b] = self.m1[b].min()
             if unanimous[b]:
                 if b in pair_tables:   # "exact": the certificate audit
                     p2 = pair_tables[b]
                     cert = bool((p2 == mj).all())
-                    fwd = m + p
+                    fwd, fe = m + p, fe_first + fe_pairs
+                    if b in pair_margins:
+                        min_margin[b] = min(min_margin[b],
+                                            pair_margins[b].min())
                 else:                  # "consensus": round-1 certificate
                     p2 = np.full((p,), UNEVALUATED, p1.dtype)
                     cert = True
-                    fwd = m
-                records.append(PatchCleanserRecord(mj, cert, p1[b], p2, fwd))
+                    fwd, fe = m, fe_first
+                records.append(
+                    PatchCleanserRecord(mj, cert, p1[b], p2, fwd, fe))
                 continue
             # disagreement: the certificate died in round 1; only the
             # minority rows' recovery check remains
@@ -429,7 +526,10 @@ class _PrunedPending:
                 second = p2[grid]                       # [M, M]
                 second[np.eye(m, dtype=bool)] = p1[b]   # idempotence diagonal
                 brows = {int(i): second[i] for i in minority}
-                fwd = m + p
+                fwd, fe = m + p, fe_first + fe_pairs
+                if b in pair_margins:
+                    min_margin[b] = min(min_margin[b],
+                                        pair_margins[b].min())
             else:
                 p2 = np.full((p,), UNEVALUATED, p1.dtype)
                 brows = {}
@@ -442,12 +542,53 @@ class _PrunedPending:
                     brows[int(i)] = row
                     off = np.arange(m) != i
                     p2[grid[i][off]] = row[off]
+                    if b in row_margins:
+                        # off-diagonal row margins; the pinned diagonal
+                        # reads the phase-1 entry, already accounted above
+                        min_margin[b] = min(
+                            min_margin[b], row_margins[b][int(i)][off].min())
                 fwd = m + m * len(minority)
+                fe = fe_first + float(sum(fe_rows[i] for i in minority))
             recovered = [i for i, row in brows.items()
                          if (row == p1[b, i]).all()]
             pred = int(p1[b, max(recovered)]) if recovered else mj
             records.append(
-                PatchCleanserRecord(pred, False, p1[b], p2, fwd))
+                PatchCleanserRecord(pred, False, p1[b], p2, fwd, fe))
+        # kept for diagnostics (the bench's token-parity contract check):
+        # per-image minimum top-2 logit margin over the evaluated
+        # incremental entries; +inf without margins
+        self.min_margin = min_margin
+        if self.incr == "token-exact":
+            records = self._escalate(records, min_margin)
+        return records
+
+    def _escalate(self, records, min_margin) -> List[PatchCleanserRecord]:
+        """token-exact: re-run every image whose evaluated incremental
+        entries came within `incremental_margin` of the argmax boundary
+        through the exhaustive program (bucketed, one designed extra
+        dispatch); their records become exactly the oracle's, paying the
+        incremental cost already spent plus the full M + P sweep."""
+        pc = self.pc
+        esc = np.nonzero(min_margin < pc.config.incremental_margin)[0]
+        if not esc.size:
+            return records
+        m, p = pc.num_first, pc.num_second
+        bs = (self.bucket_sizes if self.bucket_sizes is not None
+              else data_lib.batch_buckets(int(self.imgs.shape[0])))
+        for off, cnt, bucket in data_lib.bucket_plan(int(esc.size), bs):
+            xe = data_lib.pad_to_bucket(
+                jnp.take(self.imgs, jnp.asarray(esc[off:off + cnt]), axis=0),
+                bucket)
+            pred, cert, p1, p2 = map(
+                np.asarray,
+                pc._predict(self.params, xe, int(self.num_classes)))
+            for pos in range(cnt):
+                b = int(esc[off + pos])
+                old = records[b]
+                records[b] = PatchCleanserRecord(
+                    int(pred[pos]), bool(cert[pos]), p1[pos], p2[pos],
+                    old.forwards + m + p,
+                    old.forward_equivalents + m + p)
         return records
 
 
@@ -455,16 +596,21 @@ def materialize_verdicts(entry):
     """Host-materialize one certifier's batch answer — the designated
     device-to-host sync the serving layer's `marshal_response` delegates to.
     `entry` is either the exhaustive `predict_tables` 4-tuple or a
-    `_PrunedPending`; returns `(pred [n], certified [n], forwards [n])`."""
+    `_PrunedPending`; returns `(pred [n], certified [n], forwards [n],
+    forward_equivalents [n])` — forwards counts evaluated table entries,
+    forward_equivalents their fractional full-forward cost (equal except
+    on the incremental paths)."""
     if isinstance(entry, _PrunedPending):
         recs = entry.finalize()
         return (np.asarray([r.prediction for r in recs]),
                 np.asarray([r.certification for r in recs]),
-                np.asarray([r.forwards for r in recs]))
+                np.asarray([r.forwards for r in recs]),
+                np.asarray([r.forward_equivalents for r in recs]))
     pred, certified, p1, p2 = entry
     exhaustive = int(p1.shape[1]) + int(p2.shape[1])
     pred, certified = np.asarray(pred), np.asarray(certified)
-    return pred, certified, np.full((pred.shape[0],), exhaustive)
+    full = np.full((pred.shape[0],), exhaustive)
+    return pred, certified, full, full.astype(np.float64)
 
 
 @dataclasses.dataclass
@@ -484,6 +630,16 @@ class PatchCleanser:
     # distinct image-batch size (the driver's correctness filter makes B
     # dynamic). Enforced only under --sanitize (analysis/sanitize.py).
     recompile_budget: Any = None
+    # the victim family's incremental-inference engine
+    # (`models.vit.TokenPrunedViT` | `ops.stem_fold.StemFoldEngine` |
+    # None) — see `DefenseConfig.incremental` and `resolved_incremental`
+    incremental_engine: Any = None
+    #: diagnostics: per-image minimum evaluated top-2 logit margin of the
+    #: most recent pruned `robust_predict` (a small HOST array — the
+    #: bench's token-parity contract check reads it without re-dispatching
+    #: the batch, and nothing device-resident is pinned past the call)
+    last_min_margin: Any = dataclasses.field(default=None, init=False,
+                                             repr=False)
 
     def __post_init__(self):
         singles, doubles = masks_lib.mask_sets(self.spec)
@@ -575,14 +731,51 @@ class PatchCleanser:
 
         r = self.spec.patch_ratio
         rb = self.recompile_budget
+        row_rb = (len(self.row_bucket_sizes) if rb is not None else None)
         self._phase1 = observe.timed_first_call(
             jax.jit(_phase1), f"defense.phase1.r{r}", recompile_budget=rb)
         self._pairs = observe.timed_first_call(
             jax.jit(_pairs), f"defense.pairs.r{r}", recompile_budget=rb)
         self._rows = observe.timed_first_call(
-            jax.jit(_rows), f"defense.rows.r{r}",
-            recompile_budget=(len(self.row_bucket_sizes)
-                              if rb is not None else None))
+            jax.jit(_rows), f"defense.rows.r{r}", recompile_budget=row_rb)
+
+        # forward-equivalent weights per combined-table mask (full-forward
+        # units): all-ones without an engine; the token engine's family
+        # overwrites them with (dirty tokens + 1) / (T + 1)
+        self._fe_combined = np.ones((m + self._num_doubles,), np.float64)
+        self._incr_family = None
+        self._phase1_incr = self._pairs_incr = self._rows_incr = None
+        if (self.incremental_engine is not None
+                and self.config.incremental != "off"):
+            fam = self.incremental_engine.build_family(
+                np.asarray(self._rects), m, self.config.chunk_size,
+                self.config.mask_fill)
+            self._incr_family = fam
+            kind = self.incremental_engine.kind
+            self._phase1_incr = observe.timed_first_call(
+                jax.jit(fam.phase1), f"defense.phase1.{kind}.r{r}",
+                recompile_budget=rb)
+            if kind == "token":
+                self._fe_combined = np.asarray(fam.fe, np.float64)
+                self._pairs_incr = observe.timed_first_call(
+                    jax.jit(fam.pairs), f"defense.pairs.token.r{r}",
+                    recompile_budget=rb)
+                self._rows_incr = observe.timed_first_call(
+                    jax.jit(fam.rows), f"defense.rows.token.r{r}",
+                    recompile_budget=row_rb)
+        # per-first-mask second-round row cost (all M entries of the row,
+        # idempotence diagonal included — matching the row programs, which
+        # evaluate the diagonal too). `cache_fe` charges each program
+        # invocation's per-image clean-cache forward (token engine: the
+        # cache + K/V projections; 0 elsewhere) so forward_equivalents
+        # reflects every dispatched forward, not just the masked entries:
+        # phase 1 pays it once per image, the pair audit once per
+        # dispatched image, the rows program once per gathered row entry.
+        cache_fe = float(getattr(self._incr_family, "cache_fe", 0.0) or 0.0)
+        self._fe_rows = self._fe_combined[
+            np.asarray(self._grid_full)].sum(axis=1) + cache_fe
+        self._fe_first = float(self._fe_combined[:m].sum()) + cache_fe
+        self._fe_pairs = float(self._fe_combined[m:].sum()) + cache_fe
 
     @property
     def num_first(self) -> int:
@@ -599,6 +792,16 @@ class PatchCleanser:
         """Masked forwards per image the exhaustive sweep always executes."""
         return self.num_first + self.num_second
 
+    @property
+    def first_round_forward_equivalents(self) -> float:
+        """Per-image cost of the mandatory first-round sweep in full-forward
+        units under the resolved incremental mode — the floor every
+        certified image pays (M = 36 un-pruned; the token engine's fraction
+        of that otherwise)."""
+        if self.resolved_incremental() != "off":
+            return float(self._fe_first)
+        return float(self.num_first)
+
     def resolved_prune(self, prune: Optional[str] = None) -> str:
         """The effective prune mode: explicit arg > config; meshed or
         n_patch!=1 certifiers always run "off" (see _build_pruned_programs)."""
@@ -610,11 +813,73 @@ class PatchCleanser:
             return "off"
         return mode
 
+    def resolved_incremental(self, incremental: Optional[str] = None,
+                             prune: Optional[str] = None) -> str:
+        """The effective incremental mode: explicit arg > config; "auto"
+        resolves to the attached engine's kind. Always "off" without an
+        engine (stub victims, ResMLP), without built incremental programs
+        (config.incremental="off" at construction), or when the pruned
+        dispatch path itself is off (mesh, n_patch!=1, prune="off") —
+        incremental forwards ride the two-phase schedule. An explicit
+        token/stem request that contradicts the engine family is a
+        config error, not a silent fallback."""
+        mode = (self.config.incremental if incremental is None
+                else incremental)
+        if mode not in INCREMENTAL_MODES:
+            raise ValueError(f"incremental={mode!r} "
+                             f"(legal: {', '.join(INCREMENTAL_MODES)})")
+        # meshed / n_patch!=1 certifiers never ran _build_pruned_programs
+        if getattr(self, "_incr_family", None) is None \
+                or self.resolved_prune(prune) == "off":
+            return "off"
+        kind = self.incremental_engine.kind
+        if mode == "auto":
+            # the default keeps the PR 5 verdict contract: conv families
+            # are exact by construction ("stem"); ViT families get the
+            # margin-gated escalation ("token-exact"), whose extra cost is
+            # confined to images near the argmax boundary. Plain "token"
+            # (tolerance-contracted verdicts, no escalation) is opt-in.
+            return "token-exact" if kind == "token" else kind
+        if mode != "off" and not mode.startswith(kind):
+            raise ValueError(
+                f"incremental={mode!r} but this victim family's engine "
+                f"is {kind!r}")
+        return mode
+
+    def pruned_programs(self, incremental: Optional[str] = None):
+        """`[(name, program, input_kind)]` for the programs the resolved
+        pruned(+incremental) path dispatches — the single source the
+        serving layer's trace accounting/enumeration and the audit
+        registry derive from. `input_kind`: "imgs" (params, [B,H,W,C]),
+        "rows" (params, gathered [W,H,W,C], [W] first-mask ids),
+        "rows_sets" (params, gathered [W,H,W,C], [W,M] combined-table
+        index rows — the token rows program)."""
+        r = self.spec.patch_ratio
+        mode = self.resolved_incremental(incremental)
+        if mode in ("token", "token-exact"):
+            return [
+                (f"defense.phase1.token.r{r}", self._phase1_incr, "imgs"),
+                (f"defense.pairs.token.r{r}", self._pairs_incr, "imgs"),
+                (f"defense.rows.token.r{r}", self._rows_incr, "rows_sets"),
+            ]
+        if mode == "stem":
+            return [
+                (f"defense.phase1.stem.r{r}", self._phase1_incr, "imgs"),
+                (f"defense.pairs.r{r}", self._pairs, "imgs"),
+                (f"defense.rows.r{r}", self._rows, "rows"),
+            ]
+        return [
+            (f"defense.phase1.r{r}", self._phase1, "imgs"),
+            (f"defense.pairs.r{r}", self._pairs, "imgs"),
+            (f"defense.rows.r{r}", self._rows, "rows"),
+        ]
+
     def begin_pruned(
         self, params, imgs: jax.Array, num_classes: int,
         n: Optional[int] = None,
         bucket_sizes: Optional[Sequence[int]] = None,
         prune: Optional[str] = None,
+        incremental: Optional[str] = None,
     ) -> _PrunedPending:
         """Dispatch phase 1 of the pruned certification (no host sync).
         `imgs` may already be bucket-padded (pass the real count as `n`,
@@ -624,40 +889,64 @@ class PatchCleanser:
         mode = self.resolved_prune(prune)
         if mode == "off":
             raise ValueError("begin_pruned needs prune='exact'|'consensus'")
+        incr = self.resolved_incremental(incremental, prune)
         total = int(imgs.shape[0])
         n = total if n is None else int(n)
         if bucket_sizes is not None and n and total == n:
             imgs = data_lib.pad_to_bucket(
                 imgs, data_lib.bucket_batch(n, bucket_sizes))
         return _PrunedPending(self, params, imgs, n, num_classes,
-                              bucket_sizes, mode)
+                              bucket_sizes, mode, incr)
 
-    def warm_pruned(self, params,
-                    bucket_sizes: Sequence[int]) -> None:
-        """Compile every pruned-path program for every shape bucket it can
-        see at run time: phase 1 and the pair audit per image bucket, the
-        row program per row bucket. The serving warmup calls this so live
-        traffic provably never retraces regardless of which verdict classes
-        (and worklist sizes) it produces."""
+    def warm_pruned(self, params, bucket_sizes: Sequence[int],
+                    num_classes: Optional[int] = None) -> None:
+        """Compile every program the resolved pruned(+incremental) path can
+        dispatch at run time: phase 1 and the pair audit per image bucket,
+        the row program per row bucket — and, under "token-exact", the
+        exhaustive escalation program per image bucket (pass `num_classes`;
+        it is a static argument of `_predict`). The serving warmup calls
+        this so live traffic provably never retraces regardless of which
+        verdict classes (and worklist sizes) it produces."""
         size = self.spec.img_size
+        mode = self.resolved_incremental()
+        (_, phase1, _), (_, pairs, _), (_, rows, rows_kind) = \
+            self.pruned_programs()
+
+        def run(prog, *args):
+            out = prog(*args)
+            np.asarray(out[0] if isinstance(out, tuple) else out)
+
         for b in bucket_sizes:
             imgs = jnp.full((int(b), size, size, 3), 0.5, jnp.float32)
-            np.asarray(self._phase1(params, imgs))
-            np.asarray(self._pairs(params, imgs))
+            run(phase1, params, imgs)
+            run(pairs, params, imgs)
+            if mode == "token-exact":
+                if num_classes is None:
+                    raise ValueError(
+                        "warm_pruned needs num_classes under token-exact "
+                        "(the escalation program's static argument)")
+                run(self._predict, params, imgs, int(num_classes))
+        m = self.num_first
         for w in self.row_bucket_sizes:
-            np.asarray(self._rows(
-                params, jnp.full((int(w), size, size, 3), 0.5, jnp.float32),
-                jnp.zeros((int(w),), jnp.int32)))
+            imgs_g = jnp.full((int(w), size, size, 3), 0.5, jnp.float32)
+            if rows_kind == "rows_sets":
+                sets = jnp.asarray(
+                    np.broadcast_to(np.asarray(self._grid_full)[0],
+                                    (int(w), m)).copy())
+                run(rows, params, imgs_g, sets)
+            else:
+                run(rows, params, imgs_g, jnp.zeros((int(w),), jnp.int32))
 
     def pruned_trace_counts(self) -> dict:
-        """Compiled-trace count per pruned-path program (the serving
-        layer's zero-recompile bookkeeping)."""
-        r = self.spec.patch_ratio
-        return {
-            f"defense.phase1.r{r}": int(self._phase1._cache_size()),
-            f"defense.pairs.r{r}": int(self._pairs._cache_size()),
-            f"defense.rows.r{r}": int(self._rows._cache_size()),
-        }
+        """Compiled-trace count per active pruned-path program (the serving
+        layer's zero-recompile bookkeeping); includes the escalation
+        program under "token-exact"."""
+        out = {name: int(fn._cache_size())
+               for name, fn, _ in self.pruned_programs()}
+        if self.resolved_incremental() == "token-exact":
+            out[f"defense.predict.r{self.spec.patch_ratio}"] = \
+                int(self._predict._cache_size())
+        return out
 
     def predict_tables(self, params, imgs: jax.Array, num_classes: int):
         """DEVICE-resident verdict tables `(pred [B], certified [B],
@@ -672,6 +961,7 @@ class PatchCleanser:
         self, params, imgs: jax.Array, num_classes: int,
         bucket_sizes: Optional[Sequence[int]] = None,
         prune: Optional[str] = None,
+        incremental: Optional[str] = None,
     ) -> List[PatchCleanserRecord]:
         """Batched robust prediction + certification; returns one record per
         image (the reference's per-image `robust_predict(img, certify=True)`,
@@ -696,8 +986,10 @@ class PatchCleanser:
         if n and mode != "off":
             pending = self.begin_pruned(params, imgs, num_classes,
                                         bucket_sizes=bucket_sizes,
-                                        prune=mode)
-            return pending.schedule().finalize()
+                                        prune=mode, incremental=incremental)
+            recs = pending.schedule().finalize()
+            self.last_min_margin = pending.min_margin
+            return recs
         if bucket_sizes is not None and n:
             imgs = data_lib.pad_to_bucket(
                 imgs, data_lib.bucket_batch(n, bucket_sizes))
@@ -706,7 +998,8 @@ class PatchCleanser:
         pred, certified, p1, p2 = map(np.asarray, (pred, certified, p1, p2))
         return [
             PatchCleanserRecord(int(pred[b]), bool(certified[b]), p1[b],
-                                p2[b], self.num_forwards_exhaustive)
+                                p2[b], self.num_forwards_exhaustive,
+                                float(self.num_forwards_exhaustive))
             for b in range(n)
         ]
 
@@ -719,9 +1012,13 @@ class PatchCleanser:
 
 def build_defenses(
     apply_fn, img_size: int, config: DefenseConfig = DefenseConfig(),
-    mesh=None, recompile_budget=None,
+    mesh=None, recompile_budget=None, incremental=None,
 ) -> List[PatchCleanser]:
-    """The reference driver's 4-radius defense bank (`/root/reference/main.py:61`)."""
+    """The reference driver's 4-radius defense bank (`/root/reference/main.py:61`).
+
+    `incremental` is the victim family's incremental-inference engine
+    (`models.Victim.incremental`); each certifier builds its own per-radius
+    mask-family programs from it (see `DefenseConfig.incremental`)."""
     return [
         PatchCleanser(
             apply_fn,
@@ -729,6 +1026,7 @@ def build_defenses(
             config,
             mesh=mesh,
             recompile_budget=recompile_budget,
+            incremental_engine=incremental,
         )
         for r in config.ratios
     ]

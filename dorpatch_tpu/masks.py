@@ -191,6 +191,46 @@ def dropout_universe(
     return np.concatenate(parts, axis=0)
 
 
+def rect_token_coverage(rects: np.ndarray, img_size: int,
+                        patch_px: int) -> np.ndarray:
+    """Boolean coverage of a ViT patch-token grid by rectangle sets.
+
+    rects `[N, K, 4]` (r0, r1, c0, c1 half-open, empty (0,0,0,0) rows
+    allowed) -> `[N, T]` bool with `T = (img_size // patch_px) ** 2`
+    row-major patch tokens: entry (n, t) is True iff any rectangle of mask
+    n overlaps token t's `patch_px x patch_px` pixel window. A rectangle
+    whose edge straddles a patch boundary covers BOTH straddled tokens
+    (interval overlap, not containment) — the incremental ViT path must
+    recompute every token whose pixels the mask touches at all.
+    """
+    rects = np.asarray(rects, dtype=np.int64)
+    if rects.ndim == 2:
+        rects = rects[:, None, :]
+    grid = img_size // patch_px
+    t0 = np.arange(grid) * patch_px          # token window starts
+    r0, r1 = rects[..., 0:1], rects[..., 1:2]  # [N, K, 1]
+    c0, c1 = rects[..., 2:3], rects[..., 3:4]
+    # half-open interval overlap per axis: [a0, a1) meets [t, t+patch_px)
+    rows = (r0 < t0[None, None] + patch_px) & (r1 > t0[None, None])  # [N,K,G]
+    cols = (c0 < t0[None, None] + patch_px) & (c1 > t0[None, None])
+    cover = rows[..., :, None] & cols[..., None, :]  # [N, K, G, G]
+    return cover.any(axis=1).reshape(rects.shape[0], grid * grid)
+
+
+def token_coverage(spec: MaskSpec, patch_px: int) -> np.ndarray:
+    """`[M, T]` bool: first-round mask i touches ViT patch token t.
+
+    The union coverage of a mask *pair* {i, j} is `cov[i] | cov[j]` — the
+    combined-table rows `rect_token_coverage(mask_sets(...)[1], ...)`
+    produces directly. Consumed by the token-pruned incremental certify
+    path (`models/vit.py:TokenPrunedViT`)."""
+    if spec.img_size % patch_px:
+        raise ValueError(
+            f"patch_px={patch_px} does not divide img_size={spec.img_size}")
+    return rect_token_coverage(first_order_rects(spec)[:, None, :],
+                               spec.img_size, patch_px)
+
+
 def rasterize(rects: jax.Array, img_size: int) -> jax.Array:
     """Rasterize rectangle sets `[..., K, 4]` to boolean masks `[..., H, W]`.
 

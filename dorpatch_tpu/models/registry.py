@@ -30,7 +30,11 @@ class Victim(NamedTuple):
     """A victim classifier: `logits = apply(params, images01)`.
 
     `apply` expects NHWC float images in [0,1] (normalization folded in) and
-    is safe to jit/vmap/grad-through.
+    is safe to jit/vmap/grad-through. `incremental` is the family's
+    mask-aware incremental-inference engine (`models.vit.TokenPrunedViT`
+    for the ViT families, `ops.stem_fold.StemFoldEngine` for the conv
+    families, None where no engine exists) — `defense.build_defenses`
+    consumes it for `DefenseConfig.incremental`.
     """
 
     name: str
@@ -38,6 +42,7 @@ class Victim(NamedTuple):
     params: Any
     num_classes: int
     from_checkpoint: bool
+    incremental: Any = None
 
 
 def resolve_arch(arch: str) -> str:
@@ -112,6 +117,59 @@ def init_program(timm_name: str, num_classes: int, img_size: int,
     return program, example_args
 
 
+def _normalize(images01):
+    """The folded victim normalization (`NormModel`, mean/std = 0.5)."""
+    return (images01 - 0.5) / 0.5
+
+
+#: d(normalized)/d(image01): the linear scale the masked-stem fold uses to
+#: express the occlusion fill's delta in normalized space.
+_NORM_SCALE = 2.0
+
+
+def incremental_engine(timm_name: str, model, img_size: int):
+    """The family's mask-aware incremental-inference engine, or None.
+
+    ViT families get the token-pruned engine (clean KV cache + dirty-token
+    recompute, `models/vit.py`); conv families get the exact masked-stem
+    fold (`ops/stem_fold.py`). ResMLP has neither (its token-mixing MLP
+    makes every token dirty after one block) and runs the standard path.
+    """
+    if timm_name in ("vit_base_patch16_224", "cifar_vit"):
+        from dorpatch_tpu.models.vit import TokenPrunedViT
+
+        if img_size % model.patch_size:
+            return None  # non-grid-aligned input: no token geometry
+        return TokenPrunedViT(model, img_size, normalize=_normalize)
+    if timm_name == "cifar_resnet18":
+        from dorpatch_tpu.ops.stem_fold import StemFoldEngine
+
+        return StemFoldEngine(
+            model, img_size,
+            kernel_fn=lambda p: p["params"]["stem"]["kernel"],
+            kernel_hw=3, strides=(1, 1), pads=((1, 1), (1, 1)),
+            normalize=_normalize, norm_scale=_NORM_SCALE)
+    if timm_name == "resnetv2_50x1_bit_distilled":
+        import jax.numpy as jnp_mod
+
+        from dorpatch_tpu.ops.stem_fold import StemFoldEngine, same_pads
+
+        def std_kernel(p, _eps=1e-8):
+            # the StdConv weight standardization (models/resnetv2.py),
+            # folded so the delta conv uses the same effective kernel
+            kern = p["params"]["stem_conv"]["kernel"]
+            mean = jnp_mod.mean(kern, axis=(0, 1, 2), keepdims=True)
+            var = jnp_mod.var(kern, axis=(0, 1, 2), keepdims=True)
+            return (kern - mean) * jax.lax.rsqrt(var + _eps)
+
+        return StemFoldEngine(
+            model, img_size, kernel_fn=std_kernel, kernel_hw=7,
+            strides=(2, 2),
+            pads=(same_pads(img_size, 7, 2), same_pads(img_size, 7, 2)),
+            normalize=_normalize, norm_scale=_NORM_SCALE)
+    return None
+
+
 def _convert(timm_name: str, state_dict):
     if timm_name == "resnetv2_50x1_bit_distilled":
         from dorpatch_tpu.models.convert import convert_resnetv2
@@ -174,7 +232,7 @@ def get_model(
         from_checkpoint = False
 
     def apply(params, images01):
-        return model.apply(params, (images01 - 0.5) / 0.5)
+        return model.apply(params, _normalize(images01))
 
     return Victim(
         name=timm_name,
@@ -182,4 +240,5 @@ def get_model(
         params=params,
         num_classes=num_classes,
         from_checkpoint=from_checkpoint,
+        incremental=incremental_engine(timm_name, model, img_size),
     )

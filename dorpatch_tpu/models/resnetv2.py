@@ -147,9 +147,21 @@ class ResNetV2(nn.Module):
     gn_impl: str = "auto"
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, mode: str = "full"):
+        """mode="full": logits from images. mode="stem": only the
+        weight-standardized stem conv's output, BEFORE the pad+max-pool
+        (the pool is nonlinear but local; the linear shareable cache of the
+        masked-stem incremental certify path, `ops/stem_fold.py`, must stop
+        at the conv). mode="trunk": `x` is a stem-conv output; run the
+        pad+pool and everything after. `full(x) == trunk(stem(x))` exactly;
+        all modes share one parameter tree."""
+        if mode not in ("full", "stem", "trunk"):
+            raise ValueError(f"mode={mode!r} (use 'full', 'stem' or 'trunk')")
         wf = self.width_factor
-        x = StdConv(self.stem_features * wf, (7, 7), (2, 2), name="stem_conv")(x)
+        if mode != "trunk":
+            x = StdConv(self.stem_features * wf, (7, 7), (2, 2), name="stem_conv")(x)
+            if mode == "stem":
+                return x
         # timm "fixed" stem pool: ConstantPad2d(1, 0.) then VALID 3x3/2 pool.
         # Zero pad (not -inf) is deliberate — see module docstring.
         x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))

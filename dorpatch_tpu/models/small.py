@@ -37,8 +37,21 @@ class CifarResNet18(nn.Module):
     stage_sizes: Sequence[int] = (2, 2, 2, 2)
 
     @nn.compact
-    def __call__(self, x):
-        x = nn.Conv(64, (3, 3), padding=1, use_bias=False, name="stem")(x)
+    def __call__(self, x, mode: str = "full"):
+        """mode="full": logits from images. mode="stem": only the bias-free
+        stem conv's PRE-norm output (the linear cache of the masked-stem
+        incremental certify path, `ops/stem_fold.py` — GroupNorm is a
+        global nonlinearity, so the shareable-across-masks activation must
+        be cut before it). mode="trunk": `x` is already a stem output; run
+        everything after the stem conv. `full(x) == trunk(stem(x))`
+        exactly, and the three modes share one parameter tree (a mode that
+        skips a submodule simply leaves its params unread)."""
+        if mode not in ("full", "stem", "trunk"):
+            raise ValueError(f"mode={mode!r} (use 'full', 'stem' or 'trunk')")
+        if mode != "trunk":
+            x = nn.Conv(64, (3, 3), padding=1, use_bias=False, name="stem")(x)
+            if mode == "stem":
+                return x
         x = nn.relu(nn.GroupNorm(num_groups=8, name="stem_norm")(x))
         features = 64
         for si, depth in enumerate(self.stage_sizes):

@@ -1,4 +1,5 @@
-"""Flax ViT-B/16, NHWC, matching timm's `vit_base_patch16_224`.
+"""Flax ViT-B/16, NHWC, matching timm's `vit_base_patch16_224`, plus the
+token-pruned incremental masked-inference engine.
 
 Second victim family of the reference (`/root/reference/utils.py:51-52`).
 timm contract: 16x16 conv patch embed (with bias), cls token, learned
@@ -10,14 +11,45 @@ token.
 TPU notes: attention is batched matmuls on the MXU; sequence length 197 is
 small, so no flash/ring attention is needed here — the EOT/mask axis is this
 workload's scaling dimension (SURVEY.md §5) and is sharded at the batch level.
+
+Incremental masked inference (`TokenPrunedViT`, ROADMAP item 1): a
+PatchCleanser occlusion mask is a small contiguous window, so for most patch
+tokens the masked image's pixels — and therefore the layer-0 token
+embeddings — are bit-identical to the clean image's. The engine computes the
+clean per-block input activations ONCE per image (`ViT.__call__` mode
+="cache") and projects them through every block's key/value heads once
+(`TokenPrunedViT._clean_kv` — the shared clean KV cache), then per mask
+recomputes only the mask-touched patch tokens (plus the cls readout token):
+queries, the dirty rows' K/V projections (scattered into the cached K/V),
+and the MLP all run on the dirty rows alone, so per-mask cost scales with
+`dirty_tokens / total_tokens` instead of 1.0 — projecting K/V from the
+substituted *activations* instead would put 2/3 of the attention projection
+cost back on every entry, which measurement showed erases the win.
+
+Exactness contract: the dirty tokens' updates are exact *given their block
+inputs* — in particular the final block's cls readout is computed exactly
+from the (substituted) final-block KV — but untouched tokens keep their
+clean activations at every depth, while in the true masked forward they
+would drift from attending to dirty tokens from block 1 on. The resulting
+logit drift is small (the mask touches a few tokens out of 65/197) but not
+zero; the engine therefore returns top-2 logit *margins* alongside each
+prediction, and `defense.py`'s "token-exact" mode re-runs any image whose
+read table entries sit within the configured margin of the decision
+boundary through the exhaustive program, making *verdicts* bit-identical
+whenever the drift stays below that documented tolerance
+(`DefenseConfig.incremental_margin`).
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+import numpy as np
+
+from dorpatch_tpu import masks as masks_lib
 
 
 class ViTBlock(nn.Module):
@@ -52,7 +84,14 @@ class ViT(nn.Module):
     img_size: Tuple[int, int] = (224, 224)
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, mode: str = "full"):
+        """mode="full": logits. mode="cache": the tuple of per-block INPUT
+        activations `depth x [B, T+1, D]` — the incremental engine's clean
+        KV cache. Cache mode stops before the final block executes (its
+        output feeds only the head, which the cache does not include), so
+        the traced program carries no dead compute."""
+        if mode not in ("full", "cache"):
+            raise ValueError(f"mode={mode!r} (use 'full' or 'cache')")
         B = x.shape[0]
         x = nn.Conv(
             self.dim,
@@ -69,7 +108,11 @@ class ViT(nn.Module):
             "pos_embed", nn.initializers.normal(0.02), (1, n_tokens, self.dim), jnp.float32
         )
         x = x + pos
+        cache = []
         for i in range(self.depth):
+            cache.append(x)
+            if mode == "cache" and i == self.depth - 1:
+                return tuple(cache)
             x = ViTBlock(self.dim, self.num_heads, name=f"block{i}")(x)
         x = nn.LayerNorm(epsilon=1e-6, name="norm")(x)
         return nn.Dense(self.num_classes, name="head")(x[:, 0])
@@ -92,3 +135,341 @@ CIFAR_VIT = dict(patch_size=4, dim=128, depth=6, num_heads=4,
 
 def vit_cifar(num_classes: int) -> ViT:
     return ViT(num_classes=num_classes, **CIFAR_VIT)
+
+
+# ------------------------------------------- token-pruned incremental engine
+
+
+def _default_normalize(x):
+    """Fallback for directly-constructed engines; the factory
+    (`models.registry.incremental_engine`) always passes its own
+    `registry._normalize`, the single production definition."""
+    return (x - 0.5) / 0.5
+
+
+class _TokenTables(NamedTuple):
+    """Static per-mask-family lookup tables, device-resident (closed-over
+    DEVICE arrays are the params idiom the program auditor exempts from
+    DP203; host numpy constants this size would be flagged)."""
+
+    idx: jax.Array      # [N, S] int32 sequence positions (0 = cls, patch t -> t+1)
+    keep: jax.Array     # [N, S-1, p, p, 1] f32 pixel keep-mask per dirty patch slot
+    slot_bias: jax.Array  # [N, S] f32 additive attention bias: 0 for real
+    #                       dirty slots, -1e9 for duplicate padding slots
+    #                       (their K/V rows must not count twice)
+    fe: np.ndarray      # [N] float64 forward equivalents: (dirty tokens + 1) / (T + 1)
+
+
+def _build_tables(rects: np.ndarray, img_size: int, patch: int) -> _TokenTables:
+    """Token sets + per-token pixel keep masks for one rectangle table.
+
+    Slots beyond a mask's real coverage repeat slot 0 (same token, same
+    keep mask), so the padded slots compute the identical dirty value and
+    the KV scatter stays deterministic under duplicate indices."""
+    rects = np.asarray(rects, np.int64)
+    if rects.ndim == 2:
+        rects = rects[:, None, :]
+    grid = img_size // patch
+    cov = masks_lib.rect_token_coverage(rects, img_size, patch)  # [N, T]
+    n, t_total = cov.shape
+    s_max = int(cov.sum(axis=1).max())
+    idx = np.zeros((n, s_max + 1), np.int32)
+    keep = np.ones((n, s_max, patch, patch, 1), np.float32)
+    slot_bias = np.zeros((n, s_max + 1), np.float32)
+    for i in range(n):
+        toks = np.nonzero(cov[i])[0]
+        padded = np.concatenate([toks, np.full(s_max - len(toks), toks[0])])
+        idx[i, 1:] = padded + 1  # sequence position; slot 0 stays cls (0)
+        slot_bias[i, 1 + len(toks):] = -1e9
+        for s, tok in enumerate(padded):
+            pr, pc = divmod(int(tok), grid)
+            r_off, c_off = pr * patch, pc * patch
+            for r0, r1, c0, c1 in rects[i]:
+                rr0, rr1 = max(r0 - r_off, 0), min(r1 - r_off, patch)
+                cc0, cc1 = max(c0 - c_off, 0), min(c1 - c_off, patch)
+                if rr0 < rr1 and cc0 < cc1:
+                    keep[i, s, rr0:rr1, cc0:cc1, 0] = 0.0
+    fe = (cov.sum(axis=1) + 1.0) / float(t_total + 1)
+    return _TokenTables(jnp.asarray(idx), jnp.asarray(keep),
+                        jnp.asarray(slot_bias), fe)
+
+
+class TokenViTFamily:
+    """One mask family's incremental programs: the combined rectangle table
+    `[singles; pairs]` of a certifier (`defense.PatchCleanser._rects`
+    layout) compiled into three jit-friendly callables —
+
+    - `phase1(params, imgs)`: the `[B, M]` first-round table,
+    - `pairs(params, imgs)`: the `[B, P]` pair-audit table,
+    - `rows(params, imgs_g, sets_idx)`: ragged second-round rows, one
+      gathered image and one `[M2]` combined-table index row per entry
+
+    — each returning `(preds int32, margins f32)` of identical shape, where
+    the margin is the masked forward's top-1/top-2 logit gap (the
+    token-exact escalation signal). Forward-equivalent weights per combined
+    mask are in `.fe`; `fe_first`/`fe_pairs` are the per-image sums."""
+
+    def __init__(self, engine: "TokenPrunedViT", rects: np.ndarray,
+                 num_singles: int, chunk_size: int, fill: float):
+        self.engine = engine
+        self.num_singles = int(num_singles)
+        self.chunk_size = max(1, int(chunk_size))
+        self.fill = float(fill)
+        img, patch = engine.img_size, engine.patch
+        self.first = _build_tables(rects[:num_singles], img, patch)
+        self.pair_tables = _build_tables(rects[num_singles:], img, patch)
+        self.combined = _build_tables(rects, img, patch)
+        self.fe = self.combined.fe
+        self.fe_first = float(self.fe[:num_singles].sum())
+        self.fe_pairs = float(self.fe[num_singles:].sum())
+        # per-invocation clean-cache cost in full-forward units: every
+        # program run computes the clean activations once per image ("cache"
+        # mode, ~(depth-1)/depth of a forward) plus the K/V projections
+        # (~1/6 — 2 of the 12 D^2-matmuls per block). The defense's fe
+        # accounting charges this on top of the per-mask fractions so
+        # `forward_equivalents` reflects ALL dispatched work.
+        depth = max(1, int(engine.module.depth))
+        self.cache_fe = (depth - 1) / depth + 1.0 / 6.0
+
+    # the three program bodies defense.py wraps in jax.jit ----------------
+
+    def phase1(self, params, imgs):
+        return self.engine._table(params, imgs, self.first,
+                                  self.fill, self.chunk_size)
+
+    def pairs(self, params, imgs):
+        return self.engine._table(params, imgs, self.pair_tables,
+                                  self.fill, self.chunk_size)
+
+    def rows(self, params, imgs_g, sets_idx):
+        return self.engine._rows(params, imgs_g, sets_idx, self.combined,
+                                 self.fill, self.chunk_size)
+
+
+class TokenPrunedViT:
+    """Token-pruned incremental masked inference for one ViT victim.
+
+    Built by `models.registry.get_model` for the ViT families and handed to
+    `defense.build_defenses(..., incremental=...)`; `build_family` is called
+    once per certifier (mask radius) with its combined rectangle table."""
+
+    kind = "token"
+
+    def __init__(self, module: ViT, img_size: int,
+                 normalize: Optional[Callable[[jax.Array], jax.Array]] = None):
+        if img_size % module.patch_size:
+            raise ValueError(
+                f"img_size={img_size} not divisible by patch "
+                f"{module.patch_size}")
+        self.module = module
+        self.img_size = int(img_size)
+        self.patch = int(module.patch_size)
+        self.grid = self.img_size // self.patch
+        self.tokens = self.grid * self.grid
+        self.normalize = normalize or _default_normalize
+
+    def build_family(self, rects: np.ndarray, num_singles: int,
+                     chunk_size: int, fill: float) -> TokenViTFamily:
+        return TokenViTFamily(self, rects, num_singles, chunk_size, fill)
+
+    # ------------------------------------------------------------ internals
+
+    def _patches(self, imgs: jax.Array) -> jax.Array:
+        """[B, H, W, C] -> [B, T, p, p, C] row-major patches (the conv
+        patch embed's token order)."""
+        b, h, w, c = imgs.shape
+        p, g = self.patch, self.grid
+        x = imgs.reshape(b, g, p, g, p, c)
+        return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(b, g * g, p, p, c)
+
+    def _embed(self, params, patches_g, keep, seq_pos, fill):
+        """Dirty-token embeddings: occlude the gathered raw patches with the
+        static keep masks, normalize, apply the patch-embed conv (a p-stride
+        p-kernel conv == one einsum per token) and add the position rows."""
+        p = params["params"]
+        masked = patches_g * keep + fill * (1.0 - keep)
+        xn = self.normalize(masked)
+        emb = jnp.einsum("...hwc,hwcd->...d", xn,
+                         p["patch_embed"]["kernel"]) + p["patch_embed"]["bias"]
+        return emb + p["pos_embed"][0][seq_pos]
+
+    @staticmethod
+    def _ln(x, p, eps=1e-6):
+        """flax `nn.LayerNorm` twin (fast-variance formula) over params
+        {scale, bias} — applied manually so the incremental blocks can run
+        straight off the parameter tree."""
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        mean2 = jnp.mean(x * x, axis=-1, keepdims=True)
+        var = jnp.maximum(0.0, mean2 - mean * mean)
+        y = (x - mean) * jax.lax.rsqrt(var + eps)
+        return y * p["scale"] + p["bias"]
+
+    def _clean_kv(self, params, cache):
+        """Per-block clean KEY/VALUE projections of the cached activations
+        — the shared KV cache proper. Computed once per image (2/12 of a
+        forward per block); per masked entry only the few dirty rows'
+        projections are recomputed and scattered in, so attention cost
+        scales with dirty_tokens, not T."""
+        p = params["params"]
+        ks, vs = [], []
+        for layer in range(self.module.depth):
+            bp = p[f"block{layer}"]
+            ln = self._ln(cache[layer], bp["norm1"])
+            a = bp["attn"]
+            ks.append(jnp.einsum("btd,dhf->bthf", ln, a["key"]["kernel"])
+                      + a["key"]["bias"])
+            vs.append(jnp.einsum("btd,dhf->bthf", ln, a["value"]["kernel"])
+                      + a["value"]["bias"])
+        return tuple(ks), tuple(vs)
+
+    def _forward(self, params, d, kcs, vcs, idx, slot_bias):
+        """Dirty tokens `d [B, C, S, D]` (C masks per image) through every
+        block against the per-IMAGE clean KV caches (`kcs`/`vcs`:
+        `depth x [B, T+1, H, hd]`). Attention concatenates two key/value
+        groups per query: the shared clean cache — read IN PLACE via a
+        batched einsum, never copied per mask; the stale rows at the dirty
+        positions are excluded with an additive -1e9 bias — and the S
+        dirty rows' freshly projected K/V (duplicate padding slots masked
+        by `slot_bias`). Queries, dirty K/V projections, and the MLP all
+        run on the S dirty rows only, so per-entry cost scales with
+        S/(T+1) in both FLOPs and memory traffic. Then the cls readout ->
+        logits [B, C, num_classes]. Math mirrors flax
+        `nn.MultiHeadDotProductAttention` (scaled q, per-head softmax;
+        softmax is order-invariant, so regrouping the sequence cannot
+        change the probabilities beyond summation order)."""
+        p = params["params"]
+        t1 = kcs[0].shape[1]
+        hd = self.module.dim // self.module.num_heads
+        scale = 1.0 / float(np.sqrt(hd))
+        # [B, C, T+1] additive bias masking the clean rows that sit at
+        # dirty positions (their cached K/V is stale; the dirty group
+        # carries the fresh rows). Mask geometry is layer-independent.
+        stale = jnp.any(idx[..., None] == jnp.arange(t1), axis=-2)
+        clean_bias = jnp.where(stale, -1e9, 0.0)[..., None, None, :]
+        dirty_bias = slot_bias[..., None, None, :]
+        for layer in range(self.module.depth):
+            bp = p[f"block{layer}"]
+            a = bp["attn"]
+            ln_d = self._ln(d, bp["norm1"])
+            q = jnp.einsum("bcsd,dhf->bcshf", ln_d, a["query"]["kernel"]) \
+                + a["query"]["bias"]
+            q = q * scale
+            kd = jnp.einsum("bcsd,dhf->bcshf", ln_d, a["key"]["kernel"]) \
+                + a["key"]["bias"]
+            vd = jnp.einsum("bcsd,dhf->bcshf", ln_d, a["value"]["kernel"]) \
+                + a["value"]["bias"]
+            wc = jnp.einsum("bcshf,bthf->bchst", q, kcs[layer]) + clean_bias
+            wd = jnp.einsum("bcshf,bcthf->bchst", q, kd) + dirty_bias
+            w = jax.nn.softmax(jnp.concatenate([wc, wd], axis=-1), axis=-1)
+            o = jnp.einsum("bchst,bthf->bcshf", w[..., :t1], vcs[layer]) \
+                + jnp.einsum("bchst,bcthf->bcshf", w[..., t1:], vd)
+            d = d + jnp.einsum("bcshf,hfd->bcsd", o, a["out"]["kernel"]) \
+                + a["out"]["bias"]
+            ln2 = self._ln(d, bp["norm2"])
+            h = nn.gelu(ln2 @ bp["mlp_fc1"]["kernel"]
+                        + bp["mlp_fc1"]["bias"], approximate=False)
+            d = d + (h @ bp["mlp_fc2"]["kernel"] + bp["mlp_fc2"]["bias"])
+        cls = self._ln(d[..., 0, :], p["norm"])
+        return cls @ p["head"]["kernel"] + p["head"]["bias"]
+
+    @staticmethod
+    def _preds_margins(logits):
+        from dorpatch_tpu.utils import preds_margins
+
+        return preds_margins(logits)
+
+    def _chunk(self, params, patches, cls0, kcs, vcs, idxc, keepc, biasc,
+               fill):
+        """One mask chunk: [B images, c masks] dirty-token batch against
+        the per-image clean KV caches (shared across the mask axis — the
+        einsums read them in place). Tables are PER-IMAGE (`[B, c, ...]`):
+        the phase-1/pair programs broadcast one shared mask chunk over the
+        batch, the rows program passes each gathered image its own
+        second-mask chunk."""
+        b, c = idxc.shape[0], idxc.shape[1]
+        dim = self.module.dim
+        tok = idxc[..., 1:] - 1                                 # [B, c, S-1]
+        pg = jax.vmap(lambda pp, ii: pp[ii])(patches, tok)      # [B, c, S-1, p, p, C]
+        emb = self._embed(params, pg, keepc, idxc[..., 1:], fill)
+        cls = jnp.broadcast_to(cls0[:, None], (b, c, 1, dim))
+        d = jnp.concatenate([cls, emb], axis=2)                 # [B, c, S, D]
+        logits = self._forward(params, d, kcs, vcs, idxc, biasc)
+        return self._preds_margins(logits)                      # [B, c] each
+
+    def _table(self, params, imgs, tables: _TokenTables, fill, chunk_size):
+        """All N masks of `tables` over the batch -> (preds, margins)
+        `[B, N]`, scanning mask chunks of <= chunk_size (the same live-
+        memory bound as `defense.masked_predictions`). Padding masks repeat
+        entry 0 and are sliced off."""
+        n = int(tables.idx.shape[0])
+        c = min(max(1, int(chunk_size)), n) if n else 1
+        n_chunks = -(-n // c) if n else 0
+        pad = n_chunks * c - n
+        def padded(t):
+            return jnp.concatenate(
+                [t, jnp.broadcast_to(t[:1], (pad,) + t.shape[1:])]
+            ).reshape((n_chunks, c) + t.shape[1:])
+
+        idx_p = padded(tables.idx)
+        keep_p = padded(tables.keep)
+        bias_p = padded(tables.slot_bias)
+        cache = self.module.apply(params, self.normalize(imgs), "cache")
+        kcs, vcs = self._clean_kv(params, cache)
+        cls0 = cache[0][:, :1]
+        patches = self._patches(imgs)
+        b = imgs.shape[0]
+
+        def body(carry, xs):
+            idxc, keepc, biasc = xs
+
+            def bc(t):  # shared mask chunk -> per-image [B, c, ...]
+                return jnp.broadcast_to(t[None], (b,) + t.shape)
+
+            return carry, self._chunk(params, patches, cls0, kcs, vcs,
+                                      bc(idxc), bc(keepc), bc(biasc), fill)
+
+        _, (preds, margins) = jax.lax.scan(body, None,
+                                           (idx_p, keep_p, bias_p))
+        preds = jnp.moveaxis(preds, 0, 1).reshape(b, -1)[:, :n]
+        margins = jnp.moveaxis(margins, 0, 1).reshape(b, -1)[:, :n]
+        return preds, margins
+
+    def _rows(self, params, imgs_g, sets_idx, combined: _TokenTables, fill,
+              chunk_size):
+        """Ragged second-round rows: entry w = (gathered image, [M2] row of
+        combined-table mask indices). The second-mask axis is processed in
+        chunks of `max(1, chunk_size // W)` so each scan step is a
+        [W, c]-shaped batch (the same `chunk_size` live-entry bound as the
+        table programs) instead of M2 tiny per-mask steps — small-shape
+        dispatch overhead would otherwise dominate the token path's FLOP
+        savings."""
+        w, m2 = int(sets_idx.shape[0]), int(sets_idx.shape[1])
+        c = max(1, min(m2, int(chunk_size) // max(1, w)))
+        n_chunks = -(-m2 // c)
+        pad = n_chunks * c - m2
+        sets_p = jnp.concatenate(
+            [sets_idx, jnp.broadcast_to(sets_idx[:, :1], (w, pad))], axis=1)
+        idx_all = combined.idx[sets_p]        # [W, M2p, S]
+        keep_all = combined.keep[sets_p]      # [W, M2p, S-1, p, p, 1]
+        bias_all = combined.slot_bias[sets_p]  # [W, M2p, S]
+        cache = self.module.apply(params, self.normalize(imgs_g), "cache")
+        kcs, vcs = self._clean_kv(params, cache)
+        cls0 = cache[0][:, :1]
+        patches = self._patches(imgs_g)
+
+        def chunked(t):  # [W, M2p, ...] -> scan xs [nc, W, c, ...]
+            return jnp.moveaxis(
+                t.reshape((w, n_chunks, c) + t.shape[2:]), 1, 0)
+
+        def body(carry, xs):
+            idxc, keepc, biasc = xs           # [W, c, ...]
+            return carry, self._chunk(params, patches, cls0, kcs, vcs,
+                                      idxc, keepc, biasc, fill)
+
+        _, (preds, margins) = jax.lax.scan(
+            body, None, (chunked(idx_all), chunked(keep_all),
+                         chunked(bias_all)))
+        # [nc, W, c] -> [W, nc*c] -> [:, :M2]
+        preds = jnp.moveaxis(preds, 0, 1).reshape(w, -1)[:, :m2]
+        margins = jnp.moveaxis(margins, 0, 1).reshape(w, -1)[:, :m2]
+        return preds, margins

@@ -152,6 +152,11 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
     certify_fwd = sum(int(s.get("forwards", 0)) for s in certify_spans)
     certify_exh = sum(int(s.get("forwards_exhaustive", 0))
                       for s in certify_spans)
+    # incremental accounting (mask-aware incremental forwards): the spans'
+    # fractional full-forward cost; falls back to the entry count on
+    # pre-incremental telemetry so the two totals coincide there
+    certify_fe = sum(float(s.get("forward_equivalents", s.get("forwards", 0)))
+                     for s in certify_spans)
 
     peak_mem = 0
     for b in blocks:
@@ -212,10 +217,13 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
             "forwards": certify_fwd,
             "forwards_per_image": round(certify_fwd / certify_images, 1)
             if certify_fwd and certify_images else None,
+            "forward_equivalents_per_image": round(
+                certify_fe / certify_images, 2)
+            if certify_fe and certify_images else None,
             "prune_rate": round(1.0 - certify_fwd / certify_exh, 4)
             if certify_fwd and certify_exh else None,
-            "exhaustive_speedup": round(certify_exh / certify_fwd, 2)
-            if certify_fwd and certify_exh else None,
+            "exhaustive_speedup": round(certify_exh / certify_fe, 2)
+            if certify_fe and certify_exh else None,
         },
         "mfu": mfu,
         "serve": serve,
@@ -256,6 +264,10 @@ def _summarize_serve(ev: List[dict]) -> Optional[dict]:
               if r.get("status") == "ok")
     fwd_exh = sum(int(r.get("forwards_exhaustive", 0)) for r in reqs
                   if r.get("status") == "ok")
+    # fractional full-forward cost under the incremental paths (== fwd on
+    # pre-incremental telemetry, where the attr is absent)
+    fe = sum(float(r.get("forward_equivalents", r.get("forwards", 0)))
+             for r in reqs if r.get("status") == "ok")
     total = sum(by_status.values())
     rejected = by_status.get("overloaded", 0)
     ts = [float(r["ts"]) for r in reqs if "ts" in r]
@@ -277,6 +289,8 @@ def _summarize_serve(ev: List[dict]) -> Optional[dict]:
         "reject_rate": round(rejected / total, 4) if total else 0.0,
         "certify_forwards_per_request": round(fwd / len(ok_lat), 1)
         if fwd and ok_lat else None,
+        "certify_forward_equivalents_per_request": round(fe / len(ok_lat), 2)
+        if fe and ok_lat else None,
         "certify_prune_rate": round(1.0 - fwd / fwd_exh, 4)
         if fwd and fwd_exh else None,
     }
@@ -344,8 +358,14 @@ def format_report(s: dict) -> str:
         prune = (f", prune rate {100.0 * ce['prune_rate']:.1f}%, "
                  f"{ce['exhaustive_speedup']}x vs exhaustive"
                  if ce.get("prune_rate") is not None else "")
+        incr = ""
+        fe = ce.get("forward_equivalents_per_image")
+        # the annotation marks a genuinely fractional cost, not the two
+        # aggregates' different rounding precision
+        if fe is not None and fe < ce["forwards_per_image"] - 0.5:
+            incr = f" ({fe} full-forward equivalents, incremental)"
         add(f"  certify forwards: {ce['forwards_per_image']} "
-            f"executed/image{prune}")
+            f"executed/image{incr}{prune}")
     if s["mfu"]:
         add(f"  mfu: {s['mfu'].get('mfu')} "
             f"({s['mfu'].get('achieved_tflops')} TFLOP/s achieved)")
@@ -372,8 +392,13 @@ def format_report(s: dict) -> str:
         if sv.get("certify_forwards_per_request"):
             prune = (f", prune rate {100.0 * sv['certify_prune_rate']:.1f}%"
                      if sv.get("certify_prune_rate") is not None else "")
+            incr = ""
+            fe = sv.get("certify_forward_equivalents_per_request")
+            if fe is not None and \
+                    fe < sv["certify_forwards_per_request"] - 0.5:
+                incr = f" ({fe} full-forward equivalents, incremental)"
             add(f"  certify forwards: "
-                f"{sv['certify_forwards_per_request']}/request{prune}")
+                f"{sv['certify_forwards_per_request']}/request{incr}{prune}")
 
     add("-- heartbeats --")
     if not s["heartbeats"]:

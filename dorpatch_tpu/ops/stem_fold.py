@@ -1,0 +1,221 @@
+"""Masked-stem fold: occlusion masks applied in post-stem activation space.
+
+The conv families' certification sweep spends its first layer doing
+redundant work: every one of the 36 first-round masks re-runs the stem conv
+on an image that is bit-identical to the clean one outside a small
+occlusion window, after first materializing the full `[B, 36, H, W, C]`
+masked-image tensor in HBM (`masks.apply_masks` / `ops.masked_fill`). The
+stem conv is LINEAR (bias-free in both supported families; ResNetV2's
+weight standardization is a function of the weights only), so
+
+    stem(norm(img * m + fill * (1-m)))
+      = stem(norm(img)) + stem_nb(norm_scale * (fill - img) * occ)
+
+where `occ = 1 - m` is supported only on the mask rectangles. This module
+computes the clean stem activation ONCE per image and, per mask, only the
+delta term — a small conv over the mask window's receptive field, scattered
+into a broadcast of the shared clean cache. The masked-image tensor never
+exists; the 36-mask round reads the image once and the stem runs once
+(`ROADMAP` item 1's conv leg, built on the same static-rectangle geometry
+the Pallas `ops.masked_fill` kernel rasterizes from — here the rectangles
+are known at trace time, so the windows, paddings and scatter offsets are
+all compile-time constants and XLA fuses the per-mask chain directly).
+
+Exactness: the fold is algebraically exact; the only deviation from the
+`apply_masks` + forward oracle is float summation order inside the stem
+conv, so verdicts are bit-stable in practice (asserted by the parity
+fixtures in `tests/test_defense.py`).
+
+The fold applies to the mandatory first-round (phase-1) sweep, where every
+window is small. Pair masks' joint receptive fields approach the full
+image (two far-apart rectangles), so phase-2 keeps the standard path —
+see `defense.PatchCleanser._build_pruned_programs`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Window(NamedTuple):
+    """Static per-mask fold geometry, all in PADDED input coordinates."""
+
+    o0: int      # affected output rows [o0, o1)
+    o1: int
+    oc0: int     # affected output cols [oc0, oc1)
+    oc1: int
+    i0: int      # input window rows [i0, i1) feeding those outputs
+    i1: int
+    ic0: int
+    ic1: int
+    occ: np.ndarray  # [i1-i0, ic1-ic0, 1] f32 union occlusion indicator
+
+
+def same_pads(size: int, k: int, s: int) -> Tuple[int, int]:
+    """TF/XLA 'SAME' padding split for one spatial axis (low, high)."""
+    out = -(-size // s)
+    total = max((out - 1) * s + k - size, 0)
+    return total // 2, total - total // 2
+
+
+def _axis_window(r0: int, r1: int, pad_lo: int, k: int, s: int,
+                 out_size: int) -> Tuple[int, int, int, int]:
+    """Outputs [o0, o1) whose receptive field meets input rows [r0, r1)
+    (original coords), plus the padded-coord input window [i0, i1) that
+    produces exactly those outputs under a VALID conv."""
+    a0, a1 = r0 + pad_lo, r1 + pad_lo   # padded coords
+    o0 = max(0, -(-(a0 - k + 1) // s))
+    o1 = min(out_size, (a1 - 1) // s + 1)
+    return o0, o1, o0 * s, (o1 - 1) * s + k
+
+
+def plan_windows(rects: np.ndarray, img_size: int, k: int, s: int,
+                 pads: Tuple[Tuple[int, int], Tuple[int, int]]) -> List[_Window]:
+    """Static fold plan for a rectangle table `[N, K, 4]` (empty (0,0,0,0)
+    rows ignored): per mask, the union bounding box's affected output
+    region, its input window, and the occlusion indicator restricted to
+    that window (built host-side — the rectangles are trace-time
+    constants)."""
+    (pr0, pr1), (pc0, pc1) = pads
+    h_out = (img_size + pr0 + pr1 - k) // s + 1
+    w_out = (img_size + pc0 + pc1 - k) // s + 1
+    rects = np.asarray(rects, np.int64)
+    if rects.ndim == 2:
+        rects = rects[:, None, :]
+    plan: List[_Window] = []
+    for n in range(rects.shape[0]):
+        live = [r for r in rects[n] if r[1] > r[0] and r[3] > r[2]]
+        if not live:
+            raise ValueError(f"mask {n} has no non-empty rectangle")
+        r0 = min(int(r[0]) for r in live)
+        r1 = max(int(r[1]) for r in live)
+        c0 = min(int(r[2]) for r in live)
+        c1 = max(int(r[3]) for r in live)
+        o0, o1, i0, i1 = _axis_window(r0, r1, pr0, k, s, h_out)
+        oc0, oc1, ic0, ic1 = _axis_window(c0, c1, pc0, k, s, w_out)
+        occ = np.zeros((i1 - i0, ic1 - ic0, 1), np.float32)
+        for rr0, rr1, cc0, cc1 in live:
+            occ[max(rr0 + pr0 - i0, 0):max(rr1 + pr0 - i0, 0),
+                max(cc0 + pc0 - ic0, 0):max(cc1 + pc0 - ic0, 0)] = 1.0
+        plan.append(_Window(o0, o1, oc0, oc1, i0, i1, ic0, ic1, occ))
+    return plan
+
+
+def fold_masked_stem(kernel: jax.Array, clean: jax.Array, u: jax.Array,
+                     plan: Sequence[_Window], strides: Tuple[int, int],
+                     pads) -> jax.Array:
+    """`[B, h, w, c]` clean stem cache + `[B, H, W, C]` fill-delta input
+    `u = norm_scale * (fill - img)` -> `[B, N, h, w, c]` masked stem
+    activations: one small VALID delta-conv per mask, scattered into the
+    broadcast clean cache. Everything about each mask is static, so the
+    whole fold compiles into one fused program."""
+    (pr0, pr1), (pc0, pc1) = pads
+    up = jnp.pad(u, ((0, 0), (pr0, pr1), (pc0, pc1), (0, 0)))
+    b = clean.shape[0]
+    out = jnp.broadcast_to(clean[:, None], (b, len(plan)) + clean.shape[1:])
+    for n, w in enumerate(plan):
+        win = up[:, w.i0:w.i1, w.ic0:w.ic1, :] * jnp.asarray(w.occ)
+        d = jax.lax.conv_general_dilated(
+            win, kernel, window_strides=strides, padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        out = out.at[:, n, w.o0:w.o1, w.oc0:w.oc1, :].add(d)
+    return out
+
+
+def _preds_margins(logits):
+    from dorpatch_tpu.utils import preds_margins
+
+    return preds_margins(logits)
+
+
+class StemFoldFamily:
+    """One mask family's stem-folded first-round program: `phase1(params,
+    imgs)` -> `(preds [B, M], margins [B, M])`, numerically the
+    `apply_masks` + full-forward table up to conv summation order.
+    `fe`/`fe_first` mirror the token engine's forward-equivalents contract;
+    the stem fold saves the per-mask stem recompute and the masked-image
+    HBM materialization but still runs the full trunk per mask, so it is
+    conservatively credited a full forward per entry."""
+
+    def __init__(self, engine: "StemFoldEngine", rects: np.ndarray,
+                 num_singles: int, chunk_size: int, fill: float):
+        self.engine = engine
+        self.num_singles = int(num_singles)
+        self.chunk_size = max(1, int(chunk_size))
+        self.fill = float(fill)
+        self.plan = plan_windows(rects[:num_singles], engine.img_size,
+                                 engine.kernel_hw, engine.strides[0],
+                                 engine.pads)
+        self.fe = np.ones((np.asarray(rects).shape[0],), np.float64)
+        self.fe_first = float(num_singles)
+        self.fe_pairs = float(self.fe[num_singles:].sum())
+        # already conservative: every folded entry is credited a FULL
+        # forward although its stem never ran, which over-covers the one
+        # clean stem pass (a small fraction of a forward) per image
+        self.cache_fe = 0.0
+
+    def phase1(self, params, imgs):
+        eng = self.engine
+        b, h, w, ci = imgs.shape
+        n = len(self.plan)
+        xn = eng.normalize(imgs)
+        clean = eng.module.apply(params, xn, "stem")
+        u = eng.norm_scale * (self.fill - imgs)
+        kernel = eng.kernel_fn(params)
+        # fold AND trunk per mask chunk so the live folded-stem tensor
+        # stays within the chunk_size memory contract: a stem map is
+        # (h'*w'*C')/(H*W*C) times an input image (e.g. ~21x for the CIFAR
+        # 3x3/1 64-channel stem), so the mask-chunk width shrinks by that
+        # inflation — never materializing all N stem maps at once. Chunks
+        # are a Python-unrolled loop in ONE jitted program (static ragged
+        # tail, no padding, no retrace).
+        inflation = float(np.prod(clean.shape[1:])) / float(h * w * ci)
+        c = max(1, min(n, int(self.chunk_size / max(1.0, inflation))))
+        preds, margins = [], []
+        for off in range(0, n, c):
+            part = self.plan[off:off + c]
+            folded = fold_masked_stem(kernel, clean, u, part,
+                                      eng.strides, eng.pads)  # [B, c', ...]
+            logits = eng.module.apply(
+                params, folded.reshape((-1,) + folded.shape[2:]), "trunk")
+            p, m = _preds_margins(logits)
+            preds.append(p.reshape(b, len(part)))
+            margins.append(m.reshape(b, len(part)))
+        return (jnp.concatenate(preds, axis=1),
+                jnp.concatenate(margins, axis=1))
+
+
+class StemFoldEngine:
+    """Masked-stem incremental inference for one conv victim.
+
+    `module.apply(params, x, "stem")` must yield the bias-free linear stem
+    conv output and `"trunk"` must complete the forward from it (see
+    `models.small.CifarResNet18` / `models.resnetv2.ResNetV2`);
+    `kernel_fn(params)` returns the EFFECTIVE HWIO stem kernel with any
+    weight transform (ResNetV2 standardization) folded in. Built by
+    `models.registry.get_model`; consumed by `defense.build_defenses`."""
+
+    kind = "stem"
+
+    def __init__(self, module, img_size: int,
+                 kernel_fn: Callable, kernel_hw: int,
+                 strides: Tuple[int, int],
+                 pads,
+                 normalize: Optional[Callable] = None,
+                 norm_scale: float = 2.0):
+        self.module = module
+        self.img_size = int(img_size)
+        self.kernel_fn = kernel_fn
+        self.kernel_hw = int(kernel_hw)
+        self.strides = tuple(strides)
+        self.pads = (tuple(pads[0]), tuple(pads[1]))
+        self.normalize = normalize or (lambda x: (x - 0.5) / 0.5)
+        self.norm_scale = float(norm_scale)
+
+    def build_family(self, rects: np.ndarray, num_singles: int,
+                     chunk_size: int, fill: float) -> StemFoldFamily:
+        return StemFoldFamily(self, rects, num_singles, chunk_size, fill)

@@ -170,7 +170,8 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                 cert_buckets = data.batch_buckets(cfg.batch_size)
                 defenses = build_defenses(victim.apply, cfg.img_size,
                                           cfg.defense,
-                                          recompile_budget=len(cert_buckets))
+                                          recompile_budget=len(cert_buckets),
+                                          incremental=victim.incremental)
                 attack = DorPatch(victim.apply, victim.params,
                                   victim.num_classes, cfg.attack,
                                   recompile_budget=budget)
@@ -337,6 +338,12 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                         sp_cert["forwards"] = sum(
                             max(0, r.forwards)
                             for recs_d in per_defense for r in recs_d)
+                        # fractional full-forward cost: incremental entries
+                        # (token-pruned ViT / stem-folded conv) are credited
+                        # at their true fraction of a forward
+                        sp_cert["forward_equivalents"] = round(sum(
+                            max(0.0, r.forward_equivalents)
+                            for recs_d in per_defense for r in recs_d), 2)
                         sp_cert["forwards_exhaustive"] = int(
                             x.shape[0]) * sum(d.num_forwards_exhaustive
                                               for d in defenses)

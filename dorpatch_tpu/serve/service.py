@@ -90,7 +90,7 @@ def marshal_response(reqs: List[PendingRequest], clean_logits,
         verdicts = tuple(
             RadiusVerdict(ratio=float(ratio), prediction=int(pred[i]),
                           certified=bool(cert[i]))
-            for ratio, (pred, cert, _fwd) in zip(ratios, tables)
+            for ratio, (pred, cert, _fwd, _fe) in zip(ratios, tables)
         )
         out.append(PredictResult(
             prediction=verdicts[0].prediction,
@@ -101,7 +101,9 @@ def marshal_response(reqs: List[PendingRequest], clean_logits,
             bucket=int(bucket),
             batch_images=len(reqs),
             certify_forwards=sum(int(fwd[i])
-                                 for _p, _c, fwd in tables),
+                                 for _p, _c, fwd, _fe in tables),
+            certify_forward_equivalents=float(
+                sum(fe[i] for _p, _c, _fwd, fe in tables)),
         ))
     return out
 
@@ -127,6 +129,7 @@ class CertifiedInferenceService:
         run_cfg: Optional[ExperimentConfig] = None,
         enforce_budgets: bool = True,
         clock=time.perf_counter,
+        incremental_engine: Any = None,
     ):
         self.apply_fn = apply_fn
         self.params = params
@@ -151,7 +154,8 @@ class CertifiedInferenceService:
             jax.jit(apply_fn), "serve.clean_predict",
             recompile_budget=n_buckets)
         self.defenses = build_defenses(apply_fn, img_size, defense_cfg,
-                                       recompile_budget=n_buckets)
+                                       recompile_budget=n_buckets,
+                                       incremental=incremental_engine)
         self.ratios = tuple(defense_cfg.ratios)
         # effective double-masking schedule ("off" | "exact" | "consensus",
         # resolved once — n_patch!=1 families force "off"): pruned modes
@@ -161,12 +165,19 @@ class CertifiedInferenceService:
         # from the 36-mask table alone, with round-1-only certificates)
         self.prune = (self.defenses[0].resolved_prune()
                       if self.defenses else "off")
+        # effective incremental mode (off | token | token-exact | stem):
+        # with an engine attached the pruned-path programs are the
+        # engine-backed twins, and the per-request certify cost lands in
+        # `certify_forward_equivalents` as fractional full forwards
+        self.incremental = (self.defenses[0].resolved_incremental()
+                            if self.defenses else "off")
 
         self._lock = threading.Lock()
         self._counts = {"received": 0, "completed": 0, "rejected": 0,
                         "deadline_exceeded": 0, "errors": 0, "batches": 0,
                         "batch_images": 0, "batch_slots": 0,
                         "certify_forwards": 0,
+                        "certify_forward_equivalents": 0.0,
                         "certify_forwards_exhaustive": 0}
         self._latencies_ms: List[float] = []
         self._worker: Optional[threading.Thread] = None
@@ -192,7 +203,8 @@ class CertifiedInferenceService:
                    cfg.img_size, serve_cfg=cfg.serve,
                    defense_cfg=cfg.defense,
                    result_dir=result_dir if cfg.metrics_log else None,
-                   run_cfg=cfg)
+                   run_cfg=cfg,
+                   incremental_engine=victim.incremental)
 
     # ---------------- lifecycle ----------------
 
@@ -312,8 +324,10 @@ class CertifiedInferenceService:
         if self.prune != "off":
             t0 = self._clock()
             for d in self.defenses:
-                d.warm_pruned(self.params, self.bucket_sizes)
+                d.warm_pruned(self.params, self.bucket_sizes,
+                              num_classes=self.num_classes)
             observe.record_event("serve.warmup_pruned",
+                                 incremental=self.incremental,
                                  row_buckets=[int(w) for w in
                                               self.defenses[0].row_bucket_sizes],
                                  dur_s=round(self._clock() - t0, 6))
@@ -337,21 +351,27 @@ class CertifiedInferenceService:
                 out.append((f"defense.predict.r{r}[b{b}]", d._predict,
                             (self.params, imgs, self.num_classes)))
                 if self.prune != "off":
-                    out.append((f"defense.phase1.r{r}[b{b}]", d._phase1,
-                                (self.params, imgs)))
-                    out.append((f"defense.pairs.r{r}[b{b}]", d._pairs,
-                                (self.params, imgs)))
+                    # the programs the resolved pruned(+incremental) path
+                    # actually dispatches — engine-backed twins included
+                    for name, fn, kind in d.pruned_programs():
+                        if kind == "imgs":
+                            out.append((f"{name}[b{b}]", fn,
+                                        (self.params, imgs)))
         if self.prune != "off":
             for d in self.defenses:
-                r = d.spec.patch_ratio
-                for w in d.row_bucket_sizes:
-                    imgs_g = jax.ShapeDtypeStruct(
-                        (int(w), self.img_size, self.img_size, 3),
-                        np.dtype(np.float32))
-                    mask_idx = jax.ShapeDtypeStruct((int(w),),
-                                                    np.dtype(np.int32))
-                    out.append((f"defense.rows.r{r}[w{w}]", d._rows,
-                                (self.params, imgs_g, mask_idx)))
+                for name, fn, kind in d.pruned_programs():
+                    if kind not in ("rows", "rows_sets"):
+                        continue
+                    for w in d.row_bucket_sizes:
+                        imgs_g = jax.ShapeDtypeStruct(
+                            (int(w), self.img_size, self.img_size, 3),
+                            np.dtype(np.float32))
+                        arg = (jax.ShapeDtypeStruct(
+                            (int(w),), np.dtype(np.int32))
+                            if kind == "rows" else jax.ShapeDtypeStruct(
+                                (int(w), d.num_first), np.dtype(np.int32)))
+                        out.append((f"{name}[w{w}]", fn,
+                                    (self.params, imgs_g, arg)))
         return out
 
     def trace_counts(self) -> Dict[str, int]:
@@ -462,18 +482,24 @@ class CertifiedInferenceService:
             lats = sorted(self._latencies_ms)
         s["occupancy"] = (round(s["batch_images"] / s["batch_slots"], 4)
                           if s["batch_slots"] else 0.0)
-        # certification-cost summary: mean executed masked forwards per
-        # answered request, and the fraction of the exhaustive sweep the
-        # pruned scheduler skipped (0.0 when prune=off)
+        # certification-cost summary: mean evaluated masked-table entries
+        # per answered request, their fractional full-forward cost
+        # (incremental paths), and the fraction of the exhaustive sweep the
+        # scheduler skipped (0.0 when prune=off)
         s["prune"] = self.prune
+        s["incremental"] = self.incremental
         fwd, exh = s.pop("certify_forwards"), \
             s.pop("certify_forwards_exhaustive")
+        fe = s.pop("certify_forward_equivalents")
         s["certify_forwards"] = {
             "total": fwd,
             "per_request": round(fwd / s["completed"], 1)
             if s["completed"] else None,
+            "forward_equivalents": round(fe, 2),
+            "forward_equivalents_per_request": round(fe / s["completed"], 2)
+            if s["completed"] else None,
             "prune_rate": round(1.0 - fwd / exh, 4) if exh else None,
-            "speedup_equivalent": round(exh / fwd, 2) if fwd else None,
+            "speedup_equivalent": round(exh / fe, 2) if fe else None,
         }
         # denominator = every terminal outcome, matching the report CLI's
         # all-serve.request-events accounting, so /stats and the offline
@@ -587,6 +613,7 @@ class CertifiedInferenceService:
                 status = resp.status
                 lat = getattr(resp, "latency_ms", None)
                 fwd = getattr(resp, "certify_forwards", None)
+                fe = getattr(resp, "certify_forward_equivalents", None)
                 extra = {}
                 if status == "ok" and fwd is not None:
                     # per-request certify cost, for the report CLI's serve
@@ -594,6 +621,8 @@ class CertifiedInferenceService:
                     # 666-per-radius forward count)
                     extra = {"forwards": int(fwd),
                              "forwards_exhaustive": exhaustive}
+                    if fe is not None:
+                        extra["forward_equivalents"] = round(float(fe), 2)
                 observe.record_event("serve.request", status=status,
                                      latency_s=round((lat or 0.0) / 1e3, 6),
                                      bucket=int(bucket), **extra)
@@ -605,6 +634,9 @@ class CertifiedInferenceService:
                             self._counts["certify_forwards"] += int(fwd)
                             self._counts["certify_forwards_exhaustive"] += \
                                 exhaustive
+                        if fe is not None:
+                            self._counts["certify_forward_equivalents"] += \
+                                float(fe)
                         self._latencies_ms.append(lat)
                         if len(self._latencies_ms) > 8192:
                             del self._latencies_ms[:4096]

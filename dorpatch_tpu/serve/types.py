@@ -41,9 +41,14 @@ class PredictResult:
     bucket: int          # padded batch size the request rode in
     batch_images: int    # real (unpadded) images in that batch
     certify_forwards: Optional[int] = None
-    # ^ masked forwards this image's certification executed across the
-    #   whole defense bank (the pruned scheduler's per-image cost; None
+    # ^ masked-table entries this image's certification evaluated across
+    #   the whole defense bank (the pruned scheduler's per-image cost; None
     #   only for responses predating forward accounting)
+    certify_forward_equivalents: Optional[float] = None
+    # ^ the same cost in fractional full-forward units: incremental
+    #   entries (token-pruned ViT / stem-folded conv) credited at their
+    #   true fraction of a forward — == certify_forwards when the
+    #   incremental path is off
 
     def to_dict(self) -> dict:
         out = {
@@ -58,6 +63,9 @@ class PredictResult:
         }
         if self.certify_forwards is not None:
             out["certify_forwards"] = self.certify_forwards
+        if self.certify_forward_equivalents is not None:
+            out["certify_forward_equivalents"] = round(
+                self.certify_forward_equivalents, 2)
         return out
 
 

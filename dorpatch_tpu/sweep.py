@@ -71,7 +71,8 @@ def run_sweep(
 
     defense = build_defenses(
         victim.apply, cfg.img_size,
-        dataclasses.replace(cfg.defense, ratios=(defense_ratio,)))[0]
+        dataclasses.replace(cfg.defense, ratios=(defense_ratio,)),
+        incremental=victim.incremental)[0]
 
     rows: List[Dict] = []
     grid = list(itertools.product(patch_budgets, densities, structureds))

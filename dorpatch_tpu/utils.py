@@ -81,3 +81,16 @@ def select_device(device: str = "0") -> Optional[jax.Device]:
         return None
     jax.config.update("jax_default_device", dev)
     return dev
+
+
+def preds_margins(logits):
+    """(argmax predictions int32, top-1/top-2 logit gaps) of a logits
+    array over its last axis — THE escalation signal of the incremental
+    certify engines (`models/vit.py`, `ops/stem_fold.py` share this one
+    definition so the token and stem margin semantics cannot drift)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    top2 = lax.top_k(logits, 2)[0]
+    return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            top2[..., 0] - top2[..., 1])

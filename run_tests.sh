@@ -60,3 +60,13 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   | grep -q '"parity": true' \
   || { echo "certify-prune smoke: parity/forward-count violation"; exit 1; }
 echo "certify prune smoke: OK"
+# Smoke: mask-aware incremental certification — the token-pruned ViT path
+# must reproduce the PR 5 pruned-only verdicts on a seeded batch while
+# executing strictly fewer forward-equivalents, and the conv masked-stem
+# fold must be bit-exact (tools/certify_incr_smoke.py exits non-zero and
+# lists the violations otherwise).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/certify_incr_smoke.py \
+  | grep -q '"parity": true' \
+  || { echo "certify-incr smoke: parity/forward-equivalents violation"; exit 1; }
+echo "certify incr smoke: OK"

@@ -424,3 +424,226 @@ def test_resolved_prune_validates_and_forces_off():
         _trigger_stub, masks_lib.geometry(PRUNE_IMG, 0.1, n_patch=2),
         DefenseConfig(ratios=(0.1,), prune="exact"))
     assert multi.resolved_prune() == "off"
+
+
+# ---------- mask-aware incremental forwards (DefenseConfig.incremental) ----------
+
+INCR_IMG = 32
+INCR_CLASSES = 3
+INCR_AXIS = 3    # 3x3 family (M=9, P=36): the full machinery at unit-test cost
+
+
+@pytest.fixture(scope="module")
+def tiny_vit():
+    """Small real ViT victim + its token engine (the incremental path only
+    exists for real model families; stub apply_fns resolve to "off")."""
+    from dorpatch_tpu.models.registry import incremental_engine
+    from dorpatch_tpu.models.vit import ViT
+
+    module = ViT(num_classes=INCR_CLASSES, patch_size=4, dim=32, depth=2,
+                 num_heads=2, img_size=(INCR_IMG, INCR_IMG))
+    params = module.init(jax.random.PRNGKey(7),
+                         jnp.zeros((1, INCR_IMG, INCR_IMG, 3)))
+
+    def apply_fn(p, x):
+        return module.apply(p, (x - 0.5) / 0.5)
+
+    return params, apply_fn, incremental_engine("cifar_vit", module, INCR_IMG)
+
+
+@pytest.fixture(scope="module")
+def tiny_conv():
+    from dorpatch_tpu.models.registry import incremental_engine
+    from dorpatch_tpu.models.small import CifarResNet18
+
+    module = CifarResNet18(num_classes=INCR_CLASSES)
+    params = module.init(jax.random.PRNGKey(8),
+                         jnp.zeros((1, INCR_IMG, INCR_IMG, 3)))
+
+    def apply_fn(p, x):
+        return module.apply(p, (x - 0.5) / 0.5)
+
+    return params, apply_fn, incremental_engine(
+        "cifar_resnet18", module, INCR_IMG)
+
+
+def _incr_pair(apply_fn, engine, ratio, incremental="auto",
+               margin=0.5, num_axis=INCR_AXIS, recompile_budget=None):
+    spec = masks_lib.geometry(INCR_IMG, ratio, num_mask_per_axis=num_axis)
+    oracle = PatchCleanser(apply_fn, spec,
+                           DefenseConfig(ratios=(ratio,), prune="off",
+                                         num_mask_per_axis=num_axis))
+    incr = PatchCleanser(
+        apply_fn, spec,
+        DefenseConfig(ratios=(ratio,), prune="exact",
+                      num_mask_per_axis=num_axis,
+                      incremental=incremental, incremental_margin=margin),
+        incremental_engine=engine, recompile_budget=recompile_budget)
+    return oracle, incr
+
+
+def _incr_batch():
+    rng = np.random.default_rng(11)
+    imgs = rng.uniform(0, 1, (4, INCR_IMG, INCR_IMG, 3)).astype(np.float32)
+    imgs[0] = 0.5            # gray: provably first-round unanimous
+    imgs[1, :6, :6, :] = 1.0  # bright corner: disagreement inducer
+    return jnp.asarray(imgs)
+
+
+@pytest.mark.parametrize("ratio", [0.06, 0.15])  # default + non-default radius
+def test_token_tables_parity_within_tolerance(tiny_vit, ratio):
+    """The token-pruned tables agree with the exhaustive oracle's on the
+    overwhelming majority of entries even on this random-init fixture (the
+    documented worst case: drift is comparable to random-init logit
+    margins; trained victims sit far above). n_patch=1 only — the pruned
+    path's precondition."""
+    params, apply_fn, engine = tiny_vit
+    oracle, incr = _incr_pair(apply_fn, engine, ratio)
+    x = _incr_batch()
+    want = np.asarray(masked_predictions(
+        apply_fn, params, x, oracle._rects, 64))
+    fam = incr._incr_family
+    p1, m1 = jax.jit(fam.phase1)(params, x)
+    p2, m2 = jax.jit(fam.pairs)(params, x)
+    got = np.concatenate([np.asarray(p1), np.asarray(p2)], axis=1)
+    margins = np.concatenate([np.asarray(m1), np.asarray(m2)], axis=1)
+    assert got.shape == want.shape
+    agree = (got == want).mean()
+    assert agree >= 0.85, f"entry agreement {agree:.3f} below tolerance"
+    assert (margins > 0).all()
+    # fractional forward-equivalents: strictly cheaper than full forwards,
+    # first-round fraction matching the static token coverage
+    assert 0 < fam.fe_first < incr.num_first
+    assert 0 < fam.fe_pairs < incr.num_second
+
+
+@pytest.mark.parametrize("ratio", [0.06, 0.15])
+def test_token_exact_verdicts_bit_identical(tiny_vit, ratio):
+    """"token-exact" with an infinite margin escalates every image through
+    the exhaustive program: verdicts AND tables bit-identical to the
+    oracle, with the incremental cost added on top of the full sweep in
+    the accounting."""
+    params, apply_fn, engine = tiny_vit
+    oracle, incr = _incr_pair(apply_fn, engine, ratio,
+                              incremental="token-exact",
+                              margin=float("inf"))
+    x = _incr_batch()
+    want = oracle.robust_predict(params, x, INCR_CLASSES)
+    got = incr.robust_predict(params, x, INCR_CLASSES, bucket_sizes=(1, 4))
+    full = oracle.num_forwards_exhaustive
+    for i, (w, g) in enumerate(zip(want, got)):
+        assert (g.prediction, g.certification) == \
+            (w.prediction, w.certification), f"image {i}"
+        np.testing.assert_array_equal(g.preds_1, w.preds_1)
+        np.testing.assert_array_equal(g.preds_2, w.preds_2)
+        assert g.forwards > full       # incremental entries + the re-run
+        assert g.forward_equivalents > full
+        assert w.forward_equivalents == full
+
+
+def test_token_fe_strictly_below_forwards(tiny_vit):
+    """Plain "token": every record's forward_equivalents credits the
+    dirty-token fraction — strictly below the evaluated entry count."""
+    params, apply_fn, engine = tiny_vit
+    _, incr = _incr_pair(apply_fn, engine, 0.1, incremental="token")
+    assert incr.resolved_incremental() == "token"
+    got = incr.robust_predict(params, _incr_batch(), INCR_CLASSES,
+                              bucket_sizes=(1, 4))
+    for g in got:
+        assert 0 < g.forward_equivalents < g.forwards
+    assert incr.first_round_forward_equivalents < incr.num_first
+
+
+@pytest.mark.parametrize("ratio", [0.06, 0.15])
+def test_stem_fold_exact_parity(tiny_conv, ratio):
+    """The conv masked-stem fold is algebraically exact: the folded
+    first-round table equals apply_masks + full forward, and pruned
+    verdicts stay bit-identical to the oracle. fe == forwards (the fold is
+    conservatively credited full forwards — it saves stem recompute and
+    masked-image HBM, not trunk FLOPs)."""
+    params, apply_fn, engine = tiny_conv
+    oracle, incr = _incr_pair(apply_fn, engine, ratio)
+    assert incr.resolved_incremental() == "stem"
+    x = _incr_batch()
+    spec = masks_lib.geometry(INCR_IMG, ratio, num_mask_per_axis=INCR_AXIS)
+    singles, _ = masks_lib.mask_sets(spec)
+    want_p1 = np.asarray(masked_predictions(
+        apply_fn, params, x, jnp.asarray(singles), 64))
+    p1, _m = jax.jit(incr._incr_family.phase1)(params, x)
+    np.testing.assert_array_equal(np.asarray(p1), want_p1)
+    want = oracle.robust_predict(params, x, INCR_CLASSES)
+    got = incr.robust_predict(params, x, INCR_CLASSES, bucket_sizes=(1, 4))
+    for i, (w, g) in enumerate(zip(want, got)):
+        assert (g.prediction, g.certification) == \
+            (w.prediction, w.certification), f"image {i}"
+        np.testing.assert_array_equal(g.preds_1, w.preds_1)
+        ev = g.preds_2 != UNEVALUATED
+        np.testing.assert_array_equal(g.preds_2[ev], w.preds_2[ev])
+        assert g.forward_equivalents == g.forwards
+
+
+@pytest.mark.parametrize("incremental", ["token", "token-exact"])
+def test_incremental_zero_recompile_ragged(tiny_vit, incremental):
+    """After `warm_pruned`, ragged batch sizes through the incremental
+    programs (and token-exact's escalation program) share the per-bucket
+    compiled traces — identical counts before and after traffic, under the
+    ARMED recompile watchdog."""
+    from dorpatch_tpu.analysis.sanitize import Sanitizer
+
+    params, apply_fn, engine = tiny_vit
+    buckets = (1, 2, 4)
+    _, incr = _incr_pair(apply_fn, engine, 0.1, incremental=incremental,
+                         margin=0.05, recompile_budget=len(buckets))
+    incr.warm_pruned(params, buckets, num_classes=INCR_CLASSES)
+    warm = incr.pruned_trace_counts()
+    mode = incr.resolved_incremental()
+    assert f"defense.phase1.token.r0.1" in warm
+    if incremental == "token-exact":
+        assert warm["defense.predict.r0.1"] == len(buckets)
+    base = _incr_batch()
+    with Sanitizer(debug_nans=False, log_compiles=False):
+        for n in (1, 2, 3, 4):
+            recs = incr.robust_predict(params, base[np.asarray(
+                [i % 4 for i in range(n)])], INCR_CLASSES,
+                bucket_sizes=buckets)
+            assert len(recs) == n
+    assert incr.pruned_trace_counts() == warm
+
+
+def test_resolved_incremental_validation(tiny_vit, tiny_conv):
+    params, apply_fn, engine = tiny_vit
+    cparams, capply, cengine = tiny_conv
+    oracle, incr = _incr_pair(apply_fn, engine, 0.1)
+    # no engine -> off (every stub certifier in this file)
+    assert oracle.resolved_incremental() == "off"
+    # auto preserves the verdict contract: token families escalate
+    assert incr.resolved_incremental() == "token-exact"
+    assert incr.resolved_incremental("token") == "token"
+    assert incr.resolved_incremental("token-exact") == "token-exact"
+    # family mismatch is a config error, not a silent fallback
+    with pytest.raises(ValueError):
+        incr.resolved_incremental("stem")
+    _, cincr = _incr_pair(capply, cengine, 0.1)
+    with pytest.raises(ValueError):
+        cincr.resolved_incremental("token")
+    with pytest.raises(ValueError):
+        incr.resolved_incremental("fast")
+    # the incremental path rides the pruned schedule: prune=off kills it
+    assert incr.resolved_incremental(prune="off") == "off"
+    # config.incremental="off" builds no programs: explicit requests stay off
+    spec = masks_lib.geometry(INCR_IMG, 0.1, num_mask_per_axis=INCR_AXIS)
+    off = PatchCleanser(apply_fn, spec,
+                        DefenseConfig(ratios=(0.1,), prune="exact",
+                                      num_mask_per_axis=INCR_AXIS,
+                                      incremental="off"),
+                        incremental_engine=engine)
+    assert off.resolved_incremental() == "off"
+    assert off.resolved_incremental("token") == "off"
+    # n_patch != 1 has no pruned programs at all -> off
+    multi = PatchCleanser(
+        apply_fn, masks_lib.geometry(INCR_IMG, 0.1, n_patch=2,
+                                     num_mask_per_axis=INCR_AXIS),
+        DefenseConfig(ratios=(0.1,), prune="exact", n_patch=2,
+                      num_mask_per_axis=INCR_AXIS),
+        incremental_engine=engine)
+    assert multi.resolved_incremental() == "off"

@@ -144,3 +144,61 @@ def test_apply_masks_fill():
     mn = np.asarray(m)
     assert np.allclose(out[:, :, :, :, 0][:, mn], 0.25)
     assert np.allclose(out[:, :, :, :, 0][:, ~mn], 0.5)
+
+
+# ---------- ViT patch-token coverage (incremental certify path) ----------
+
+
+def test_rect_token_coverage_edge_straddling():
+    """A rectangle whose edge straddles a patch boundary covers BOTH
+    straddled tokens; boundary-aligned rectangles cover exactly their
+    cells; empty (0,0,0,0) rows cover nothing."""
+    img, p = 32, 4  # 8x8 token grid
+    rects = np.array([
+        [[3, 9, 0, 4]],     # rows straddle cells 0-2, cols exactly cell 0
+        [[4, 8, 4, 8]],     # exactly token (1, 1)
+        [[0, 1, 31, 32]],   # one pixel in the far corner token (0, 7)
+        [[0, 0, 0, 0]],     # empty
+    ], np.int32)
+    cov = masks.rect_token_coverage(rects, img, p)
+    assert cov.shape == (4, 64)
+    assert sorted(np.nonzero(cov[0])[0]) == [0, 8, 16]   # (0..2, 0)
+    assert sorted(np.nonzero(cov[1])[0]) == [9]          # (1, 1)
+    assert sorted(np.nonzero(cov[2])[0]) == [7]          # (0, 7)
+    assert not cov[3].any()
+
+
+def test_rect_token_coverage_pair_union():
+    """K=2 rows cover the union of their rectangles' tokens — the pair
+    masks' coverage the incremental pair table uses."""
+    img, p = 32, 4
+    a = np.array([[[0, 4, 0, 4]]], np.int32)
+    b = np.array([[[28, 32, 28, 32]]], np.int32)
+    pair = np.array([[[0, 4, 0, 4], [28, 32, 28, 32]]], np.int32)
+    cov = masks.rect_token_coverage(pair, img, p)
+    want = (masks.rect_token_coverage(a, img, p)
+            | masks.rect_token_coverage(b, img, p))
+    np.testing.assert_array_equal(cov, want)
+    assert sorted(np.nonzero(cov[0])[0]) == [0, 63]
+
+
+@pytest.mark.parametrize("ratio", [0.06, 0.12])
+def test_token_coverage_matches_rasterize_oracle(ratio):
+    """token_coverage(spec, p) == brute force: token t is covered iff the
+    rasterized mask occludes any pixel of t's patch window (includes the
+    edge-straddling first-round windows the geometry produces)."""
+    img, p = 32, 4
+    spec = masks.geometry(img, ratio)
+    cov = masks.token_coverage(spec, p)
+    rects = masks.first_order_rects(spec)
+    occluded = ~_slice_rasterize(rects[:, None, :], img)  # [M, H, W]
+    g = img // p
+    want = occluded.reshape(-1, g, p, g, p).any(axis=(2, 4)).reshape(-1, g * g)
+    np.testing.assert_array_equal(cov, want)
+    # every first-round window really does straddle cell boundaries
+    assert (cov.sum(axis=1) > 1).any()
+
+
+def test_token_coverage_rejects_bad_patch():
+    with pytest.raises(ValueError):
+        masks.token_coverage(masks.geometry(32, 0.06), 5)

@@ -469,3 +469,74 @@ def test_http_front_end(tmp_path):
             urllib.request.urlopen(bad, timeout=30)
         assert ei.value.code == 400
         assert json.loads(ei.value.read())["status"] == "error"
+
+
+# ---------- incremental certify on the serve hot path ----------
+
+def test_serve_incremental_zero_recompile_e2e(tmp_path):
+    """Acceptance: with the token incremental engine enabled, warmup
+    compiles the engine-backed programs once per shape bucket and mixed
+    live traffic (ragged batches, every verdict class) never retraces —
+    trace counts identical before/after under the ARMED recompile watchdog
+    (enforce_budgets=True arms it for the worker). Responses carry the
+    fractional `certify_forward_equivalents`, /stats aggregates it, and
+    verdicts match a direct robust_predict on the same images."""
+    from dorpatch_tpu.models.registry import incremental_engine
+    from dorpatch_tpu.models.vit import ViT
+
+    module = ViT(num_classes=N_CLASSES, patch_size=4, dim=32, depth=2,
+                 num_heads=2, img_size=(IMG, IMG))
+    params = module.init(jax.random.PRNGKey(3),
+                         jnp.zeros((1, IMG, IMG, 3)))
+
+    def apply_fn(p, x):
+        return module.apply(p, (x - 0.5) / 0.5)
+
+    engine = incremental_engine("cifar_vit", module, IMG)
+    # explicit "token" (the default "auto" resolves to "token-exact",
+    # which on this random-init victim would escalate nearly every image
+    # through the exhaustive program and break the fe < forwards law this
+    # test checks; token-exact's own laws are covered in test_defense)
+    dcfg = DefenseConfig(ratios=(0.1,), chunk_size=64,
+                         num_mask_per_axis=3, incremental="token")
+    svc = CertifiedInferenceService(
+        apply_fn, params, num_classes=N_CLASSES, img_size=IMG,
+        serve_cfg=ServeConfig(max_batch=2, bucket_sizes=(1, 2),
+                              deadline_ms=60000.0),
+        defense_cfg=dcfg,
+        result_dir=str(tmp_path / "serve"),
+        incremental_engine=engine)
+    assert svc.incremental == "token"
+    imgs = make_images(6, seed=4)
+    imgs[1] = 0.5  # a provably-unanimous frame among the mix
+    with svc:
+        warm = svc.trace_counts()
+        assert "defense.phase1.token.r0.1" in warm
+        # a burst (bucket-2 batches) plus sequential singles (bucket 1)
+        results = [None] * 4
+        def worker(i):
+            results[i] = svc.predict(imgs[i])
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        results.extend(svc.predict(imgs[i]) for i in (4, 5))
+        assert all(isinstance(r, PredictResult) for r in results)
+        for r in results:
+            assert r.certify_forward_equivalents is not None
+            assert 0 < r.certify_forward_equivalents < r.certify_forwards
+        stats = svc.stats()
+        assert svc.trace_counts() == warm, "serve hot path retraced"
+    assert stats["incremental"] == "token"
+    cf = stats["certify_forwards"]
+    assert cf["forward_equivalents_per_request"] is not None
+    assert cf["forward_equivalents_per_request"] < cf["per_request"]
+    # verdict parity vs the direct defense call on the same images
+    d = svc.defenses[0]
+    direct = d.robust_predict(params, jnp.asarray(imgs),
+                              N_CLASSES, bucket_sizes=(1, 2, 8))
+    for r, rec in zip(results, direct):
+        assert r.prediction == rec.prediction
+        assert r.verdicts[0].certified == rec.certification

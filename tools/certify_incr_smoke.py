@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Incremental-certify smoke: engine-backed vs PR 5 pruned-only double
+masking on a seeded batch (CI gate, `run_tests.sh`).
+
+Two legs, one per engine family, at the production 36-mask geometry:
+
+- token (small ViT victim): `DefenseConfig.incremental="token"` must yield
+  the same verdicts as the pruned-only path on the seeded batch (the batch
+  and the deterministic init make this reproducible; entry-level drift is
+  tolerance-contracted, verdict-level checked here) while executing
+  STRICTLY LOWER forward-equivalents — the fractional full-forward cost
+  the token engine records per entry.
+- stem (CifarResNet18 victim): the masked-stem fold is algebraically
+  exact — verdicts and every evaluated second-round entry bit-identical.
+
+Prints ONE JSON line: {"metric": "certify_incr_smoke", "parity": true,
+"fe_token": ..., "fe_pruned_only": ..., ...}; exits non-zero on any
+violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu import masks as masks_lib
+    from dorpatch_tpu.config import DefenseConfig
+    from dorpatch_tpu.defense import UNEVALUATED, PatchCleanser
+    from dorpatch_tpu.models.registry import incremental_engine
+    from dorpatch_tpu.models.small import CifarResNet18
+    from dorpatch_tpu.models.vit import ViT
+
+    img, n_classes, ratio = 32, 3, 0.1
+    spec = masks_lib.geometry(img, ratio)
+    rng = np.random.default_rng(1234)
+    imgs = rng.uniform(0.0, 1.0, (3, img, img, 3)).astype(np.float32)
+    imgs[0] = 0.5                 # gray: provably first-round unanimous
+    imgs[1, :6, :6, :] = 1.0      # bright corner: disagreement inducer
+    x = jnp.asarray(imgs)
+
+    failures = []
+    stats = {"metric": "certify_incr_smoke", "images": int(x.shape[0])}
+
+    def build(apply_fn, engine, incremental):
+        return PatchCleanser(
+            apply_fn, spec,
+            DefenseConfig(ratios=(ratio,), prune="exact",
+                          incremental=incremental),
+            incremental_engine=engine if incremental != "off" else None)
+
+    # ---- token leg (small ViT) ----
+    vit = ViT(num_classes=n_classes, patch_size=4, dim=32, depth=2,
+              num_heads=2, img_size=(img, img))
+    # noqa-reason: the smoke's whole point is a pinned, reproducible victim
+    vparams = vit.init(jax.random.PRNGKey(5),  # noqa: DP104 fixed smoke seed
+                       jnp.zeros((1, img, img, 3)))
+
+    def vapply(p, xx):
+        return vit.apply(p, (xx - 0.5) / 0.5)
+
+    vengine = incremental_engine("cifar_vit", vit, img)
+    pruned = build(vapply, None, "off")
+    token = build(vapply, vengine, "token")
+    want = pruned.robust_predict(vparams, x, n_classes, bucket_sizes=(1, 4))
+    got = token.robust_predict(vparams, x, n_classes, bucket_sizes=(1, 4))
+    for i, (w, g) in enumerate(zip(want, got)):
+        if (w.prediction, w.certification) != (g.prediction,
+                                               g.certification):
+            failures.append(f"token image {i}: verdict "
+                            f"({w.prediction}, {w.certification}) != "
+                            f"({g.prediction}, {g.certification})")
+    fe_token = sum(r.forward_equivalents for r in got)
+    fe_pruned = sum(r.forward_equivalents for r in want)
+    if not fe_token < fe_pruned:
+        failures.append(f"token path not cheaper: {fe_token} "
+                        f"forward-equivalents vs pruned-only {fe_pruned}")
+    stats.update({"fe_token": round(fe_token, 1),
+                  "fe_pruned_only": round(fe_pruned, 1),
+                  "fe_first_round_token": round(
+                      token.first_round_forward_equivalents, 2)})
+
+    # ---- stem leg (CifarResNet18, exact) ----
+    conv = CifarResNet18(num_classes=n_classes)
+    cparams = conv.init(jax.random.PRNGKey(6),  # noqa: DP104 fixed smoke seed
+                        jnp.zeros((1, img, img, 3)))
+
+    def capply(p, xx):
+        return conv.apply(p, (xx - 0.5) / 0.5)
+
+    cengine = incremental_engine("cifar_resnet18", conv, img)
+    cpruned = build(capply, None, "off")
+    cstem = build(capply, cengine, "stem")
+    cwant = cpruned.robust_predict(cparams, x, n_classes, bucket_sizes=(1, 4))
+    cgot = cstem.robust_predict(cparams, x, n_classes, bucket_sizes=(1, 4))
+    for i, (w, g) in enumerate(zip(cwant, cgot)):
+        if (w.prediction, w.certification) != (g.prediction,
+                                               g.certification):
+            failures.append(f"stem image {i}: verdict mismatch")
+        if not np.array_equal(w.preds_1, g.preds_1):
+            failures.append(f"stem image {i}: first-round tables differ")
+        ev = g.preds_2 != UNEVALUATED
+        if not np.array_equal(w.preds_2[ev], g.preds_2[ev]):
+            failures.append(f"stem image {i}: evaluated second-round "
+                            "entries differ")
+
+    stats.update({"parity": not failures, "failures": failures})
+    print(json.dumps(stats))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
