@@ -846,6 +846,17 @@ def main() -> None:
     if torch_ips:
         out["baseline"] = {"impl": "torch-cpu-fp32", "arch": arch,
                            "img": img, "mode": mode}
+    # stamp the checked-in program-set identity (analysis/baselines.json
+    # fingerprints) so a bench row is attributable to the exact jit
+    # programs it measured; absent on pre-baseline checkouts
+    try:
+        from dorpatch_tpu.analysis.baseline import program_set_stamp
+
+        stamp = program_set_stamp()
+        if stamp is not None:
+            out["program_set"] = stamp
+    except Exception:
+        pass
     print(json.dumps(out))
 
 

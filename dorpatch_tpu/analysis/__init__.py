@@ -1,6 +1,6 @@
 """Static analysis + program auditing + runtime sanitizers.
 
-Three wings, one invariant set:
+Four wings, one invariant set:
 
 - **AST** (`engine.py`, `rules_output.py`, `rules_jax.py`, `cli.py`):
   rules DP101-DP107 with stable IDs, `# noqa: DPxxx` suppressions, a
@@ -14,6 +14,13 @@ Three wings, one invariant set:
   leaks, baked-in host constants, dead compute, collective-axis
   mismatches, dead donations. Catches what source cannot show but a
   device never needs to run.
+- **Baseline** (`baseline.py`, `--baseline check|update`): rules
+  DP300-DP304 comparing every entry point's canonical jaxpr fingerprint
+  and static cost vector (XLA `cost_analysis` + a jaxpr-walk estimator)
+  against the checked-in `baselines.json` — fingerprint drift, cost
+  regressions past tolerance, program-set and interface drift, and
+  recompile-budget/bucket-ladder inconsistency. Catches what only a
+  *cross-version* diff can show, without a bench.
 - **Runtime** (`sanitize.py`): the `--sanitize` pipeline flag — NaN
   debugging, `jax.log_compiles` routed into observe events, and a
   recompile-budget watchdog that fails the run when a jitted entry point

@@ -1,0 +1,739 @@
+"""Program-baseline tier: jaxpr fingerprints + static cost model (DP3xx).
+
+The AST tier (DP1xx) proves what is visible in source and the trace tier
+(DP2xx) proves what is visible in one version's jaxprs — but neither
+compares programs *across versions*. A refactor that silently doubles the
+FLOPs of `defense.phase1.r*`, regrows the pruned/incremental paths'
+forward count, or drifts an entry point's aval signature is invisible
+until a real-hardware bench runs. This module closes that hole with a
+checked-in program baseline (`analysis/baselines.json`) and a drift gate:
+
+- **Fingerprint** — a stable hash over the *canonical* form of each entry
+  point's jaxpr: variable names are positional (first-appearance order),
+  platform/process noise (function names, memory addresses, sharding
+  placeholders, thunks) is normalized away, sub-jaxprs (pjit/scan/cond
+  bodies) are rendered recursively. The body fingerprint deliberately
+  excludes weak-type flags and donation so interface-only drift is
+  separable (DP304); the interface record (input/output avals incl.
+  weak_type, donation pattern) is hashed on its own.
+- **Static cost vector** — flops / bytes-accessed / peak temp memory from
+  `jit(...).trace().lower().compile()` `cost_analysis()` +
+  `memory_analysis()` (zero device FLOPs on the CPU gate), plus an
+  always-available pure jaxpr-walk estimator (`estimate_cost`) with a
+  per-primitive breakdown, so cost checks work even where XLA's analysis
+  is unavailable and DP301 can name the dominant regressing primitive.
+
+Rules (the `--baseline check` gate; `--baseline update` regenerates the
+file deterministically — sorted keys, normalized floats — so diffs review
+cleanly):
+
+- **DP300 fingerprint-drift** — the live program's body fingerprint
+  differs from the baseline: the program changed but the baseline was not
+  regenerated in the same PR.
+- **DP301 cost-regression** — flops/bytes (compiled or estimated) grew
+  past the entry's relative tolerance: the bench-free perf-regression
+  gate. The finding names the dominant regressing primitive.
+- **DP302 entrypoint-set-drift** — an entry point was added or removed
+  relative to the baseline (coverage must stay exact: the future AOT
+  executable cache keys on this set).
+- **DP303 budget-ladder-mismatch** — the `recompile_budget` a
+  `timed_first_call` wrap declares differs from the bucket count the
+  registered program set actually implies (explicit bucket ladder, or the
+  `name[bN]`-variant count).
+- **DP304 interface-drift** — aval / weak-type / donation drift with an
+  *unchanged* body fingerprint — exactly the change that would poison an
+  AOT executable cache keyed on the fingerprint.
+
+Suppression follows the trace tier's contract: `# noqa: DP3xx` on the
+entry point's `def` line, or a reasoned `baseline.ALLOWLIST` entry
+(fnmatch glob -> {rule: reason}) for intentional cost changes that land
+in the same PR as their baseline update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import hashlib
+import json
+import pathlib
+import re
+from typing import (Any, Dict, Iterable, List, Mapping, Optional, Sequence,
+                    Tuple)
+
+from dorpatch_tpu.analysis.engine import Finding
+from dorpatch_tpu.analysis.entrypoints import EntryPoint
+from dorpatch_tpu.analysis import program as program_mod
+
+#: The checked-in baseline, shipped inside the package so the gate and the
+#: installed console scripts agree on one file.
+BASELINE_FILENAME = "baselines.json"
+
+#: DP301 default relative tolerance: cost growth up to this fraction is
+#: accepted without a finding (XLA scheduling details move bytes-accessed a
+#: little between minor refactors; real regressions are step functions).
+DEFAULT_TOLERANCE = 0.10
+
+#: Peak-temp-memory is the jitteriest metric XLA reports (buffer assignment
+#: is a heuristic); it gets a widened tolerance.
+TEMP_TOLERANCE_FACTOR = 2.0
+
+#: Per-entry tolerance overrides: fnmatch glob -> relative tolerance.
+#: An intentional cost change should instead land its `--baseline update`
+#: in the same PR; overrides are for entries whose cost is legitimately
+#: noisy across regenerations.
+TOLERANCES: Dict[str, float] = {}
+
+#: Entry-point-name glob -> {rule_id: reason} — the baseline tier's analog
+#: of `program.ALLOWLIST`, for intentional drift no source line can own.
+#: Shipped entries must carry their reason.
+ALLOWLIST: Dict[str, Dict[str, str]] = {}
+
+#: (id, name, description) rows for `--list-rules` (the baseline tier has
+#: no TraceRule objects: its rules compare two snapshots, not one jaxpr).
+BASELINE_RULE_ROWS: Tuple[Tuple[str, str, str], ...] = (
+    ("DP300", "fingerprint-drift",
+     "entry point's canonical jaxpr fingerprint differs from "
+     "analysis/baselines.json — program changed but the baseline was not "
+     "regenerated (--baseline update)"),
+    ("DP301", "cost-regression",
+     "entry point's static cost (flops/bytes, compiled or estimated) grew "
+     "past its relative tolerance vs the baseline — the bench-free "
+     "perf-regression gate"),
+    ("DP302", "entrypoint-set-drift",
+     "entry point added or removed relative to the baseline — the audited "
+     "program set (and any AOT cache keyed on it) changed shape"),
+    ("DP303", "budget-ladder-mismatch",
+     "declared timed_first_call recompile_budget differs from the bucket "
+     "count the registered program set implies"),
+    ("DP304", "interface-drift",
+     "input/output aval, weak-type, or donation drift with an UNCHANGED "
+     "body fingerprint — poisons an AOT executable cache key"),
+)
+
+BASELINE_RULE_IDS: Tuple[str, ...] = tuple(r[0] for r in BASELINE_RULE_ROWS)
+
+
+def baseline_path() -> pathlib.Path:
+    """The checked-in default baseline file (inside the package)."""
+    return pathlib.Path(__file__).with_name(BASELINE_FILENAME)
+
+
+# ------------------------------------------------------------- fingerprint
+
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+#: Eqn params dropped from the canonical rendering: process/platform noise
+#: (function names, thunks, compiler knobs) and placement metadata that the
+#: fingerprint must not depend on. Donation is interface, not body.
+_NOISE_PARAMS = frozenset({
+    "name", "backend", "device", "inline", "keep_unused",
+    "compiler_options_kvs", "jvp_jaxpr_thunk", "bwd", "fwd",
+    "donated_invars", "in_shardings", "out_shardings",
+    "in_layouts", "out_layouts", "resource_env", "ctx_mesh",
+})
+
+
+def _sha(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()[:16]
+
+
+def _aval_sig(a, weak: bool = False) -> str:
+    """`f32[2,32,32,3]`-style aval signature. `weak=True` appends the
+    weak-type marker — interface records keep it, the body canonicalization
+    drops it so weak-only drift stays separable (DP304 vs DP300)."""
+    shape = getattr(a, "shape", None)
+    dtype = getattr(a, "dtype", None)
+    if shape is None or dtype is None:
+        return _ADDR_RE.sub("0x*", str(a))
+    sig = f"{dtype}[{','.join(str(int(d)) for d in shape)}]"
+    if weak and getattr(a, "weak_type", False):
+        sig += "~w"
+    return sig
+
+
+def _norm_value(v) -> str:
+    """Deterministic, address-free rendering of a (non-jaxpr) param value."""
+    import numpy as np
+
+    if v is None or isinstance(v, (bool, int, str)):
+        return repr(v)
+    if isinstance(v, float):
+        return repr(v)
+    if isinstance(v, np.ndarray):
+        if v.size <= 8:
+            return f"arr({v.dtype}{list(v.shape)}:{v.tolist()!r})"
+        return (f"arr({v.dtype}{list(v.shape)}:"
+                f"{hashlib.sha256(np.ascontiguousarray(v).tobytes()).hexdigest()[:12]})")
+    if isinstance(v, np.generic):
+        return f"{v.dtype}:{v.item()!r}"
+    if isinstance(v, np.dtype):
+        return str(v)
+    if isinstance(v, (tuple, list)):
+        inner = ",".join(_norm_value(x) for x in v)
+        return f"({inner})" if isinstance(v, tuple) else f"[{inner}]"
+    if isinstance(v, (dict,)):
+        items = ",".join(f"{k}:{_norm_value(v[k])}" for k in sorted(v, key=str))
+        return "{" + items + "}"
+    if isinstance(v, (set, frozenset)):
+        return "{" + ",".join(sorted(_norm_value(x) for x in v)) + "}"
+    # a Mesh renders as its axis names/sizes — device objects are process
+    # noise, the logical topology is program structure
+    names = getattr(v, "axis_names", None)
+    if names and hasattr(v, "shape"):
+        try:
+            dims = ",".join(f"{n}:{int(v.shape[n])}" for n in names)
+            return f"mesh({dims})"
+        except Exception:
+            pass
+    if hasattr(v, "dtype") and hasattr(v, "shape"):
+        return f"aval({_aval_sig(v)})"
+    if callable(v):
+        return "<fn>"
+    return _ADDR_RE.sub("0x*", f"{type(v).__name__}:{v!r}"[:160])
+
+
+def _raw(j):
+    import jax
+
+    return j.jaxpr if isinstance(j, jax.core.ClosedJaxpr) else j
+
+
+def canonical_jaxpr(closed_or_raw) -> str:
+    """Canonical textual form of a jaxpr: positional variable names
+    (first-appearance order, scope-local), sorted params with noise keys
+    dropped, sub-jaxprs rendered recursively in place. Two traces of the
+    same program — fresh processes, fresh jit objects, renamed python
+    locals — produce byte-identical output; any change to an equation, a
+    literal constant, or an aval changes it."""
+    import jax
+
+    lines: List[str] = []
+
+    def render(j, depth: int) -> None:
+        j = _raw(j)
+        names: Dict[Any, str] = {}
+
+        def nm(v) -> str:
+            if isinstance(v, jax.core.Literal):
+                return f"lit({_norm_value(v.val)}:{_aval_sig(v.aval)})"
+            if isinstance(v, jax.core.DropVar):
+                return "_"
+            if v not in names:
+                names[v] = f"v{len(names)}"
+            return f"{names[v]}:{_aval_sig(v.aval)}"
+
+        pad = " " * depth
+        lines.append(pad + "in " + " ".join(nm(v) for v in j.invars))
+        if j.constvars:
+            lines.append(pad + "const " + " ".join(nm(v) for v in j.constvars))
+        for eqn in j.eqns:
+            parts: List[str] = []
+            subs: List[Any] = []
+            for k in sorted(eqn.params):
+                if k in _NOISE_PARAMS:
+                    continue
+                v = eqn.params[k]
+                sub_js = [x for x in (v if isinstance(v, (list, tuple))
+                                      else [v])
+                          if isinstance(x, (jax.core.Jaxpr,
+                                            jax.core.ClosedJaxpr))]
+                if sub_js:
+                    parts.append(f"{k}=<jaxpr:{len(sub_js)}>")
+                    subs.extend(sub_js)
+                else:
+                    parts.append(f"{k}={_norm_value(v)}")
+            outs = " ".join(nm(v) for v in eqn.outvars)
+            ins = " ".join(nm(v) for v in eqn.invars)
+            lines.append(f"{pad}{outs} = {eqn.primitive.name}"
+                         f"[{' '.join(parts)}] {ins}")
+            for s in subs:
+                lines.append(pad + "{")
+                render(s, depth + 1)
+                lines.append(pad + "}")
+        lines.append(pad + "out " + " ".join(nm(v) for v in j.outvars))
+
+    render(closed_or_raw, 0)
+    return "\n".join(lines)
+
+
+def fingerprint(closed_or_raw) -> str:
+    """16-hex stable hash of the canonical jaxpr body."""
+    return _sha(canonical_jaxpr(closed_or_raw))
+
+
+def interface_record(ctx: "program_mod.ProgramContext") -> Dict[str, Any]:
+    """The entry point's boundary contract: flat input/output aval
+    signatures (weak_type INCLUDED — the retrace/promotion hazard DP304
+    exists to catch) and the donated-argument index pattern. Long aval
+    lists (params pytrees run to hundreds of leaves) are stored as
+    count + hash + a human-readable head."""
+    import jax
+
+    ins = [_aval_sig(a, weak=True) for a in ctx.jaxpr.in_avals]
+    outs = [_aval_sig(a, weak=True) for a in ctx.jaxpr.out_avals]
+    donated: List[int] = []
+    if ctx.args_info is not None:
+        leaves = jax.tree_util.tree_leaves(
+            ctx.args_info, is_leaf=lambda x: hasattr(x, "donated"))
+        donated = [i for i, x in enumerate(leaves)
+                   if getattr(x, "donated", False)]
+    rec: Dict[str, Any] = {
+        "inputs": {"count": len(ins), "sha": _sha("|".join(ins)),
+                   "head": ins[:4]},
+        "outputs": {"count": len(outs), "sha": _sha("|".join(outs)),
+                    "head": outs[:4]},
+        "donated": donated,
+    }
+    rec["sha"] = _sha(json.dumps(rec, sort_keys=True))
+    return rec
+
+
+# -------------------------------------------------------------- cost model
+
+#: Cap the stored per-primitive breakdown: enough to name the dominant
+#: regressing primitive, small enough to keep baselines.json reviewable.
+TOP_K_PRIMITIVES = 8
+
+
+@dataclasses.dataclass
+class _CostAcc:
+    flops: float = 0.0
+    bytes: float = 0.0
+    by_primitive: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+def _eqn_flops(eqn) -> float:
+    """Analytic flops estimate for one equation. Matmuls and convs get the
+    real formula; everything else is 1 flop/output element (reductions:
+    1 flop/input element). Deliberately coarse — the estimator exists to
+    rank primitives and catch step-function regressions, not to rival
+    XLA's model."""
+    prim = eqn.primitive.name
+    out_sizes = [int(_size(v.aval)) for v in eqn.outvars
+                 if hasattr(getattr(v, "aval", None), "shape")]
+    out_size = sum(out_sizes) or 1
+    if prim == "dot_general":
+        dn = eqn.params.get("dimension_numbers")
+        lhs = getattr(eqn.invars[0], "aval", None)
+        k = 1
+        if dn is not None and lhs is not None:
+            (lhs_contract, _), _ = dn
+            for d in lhs_contract:
+                k *= int(lhs.shape[d])
+        return 2.0 * out_size * k
+    if prim == "conv_general_dilated":
+        rhs = getattr(eqn.invars[1], "aval", None)
+        if rhs is None:
+            return float(out_size)
+        dn = eqn.params.get("dimension_numbers")
+        rhs_size = _size(rhs)
+        out_feat = 1
+        if dn is not None and hasattr(dn, "rhs_spec"):
+            out_feat = int(rhs.shape[dn.rhs_spec[0]])
+        # per output element: 2 * (kernel spatial x in-channels-per-group)
+        return 2.0 * out_size * (rhs_size / max(out_feat, 1))
+    if prim.startswith("reduce_") or prim in ("argmax", "argmin"):
+        return float(sum(int(_size(v.aval)) for v in eqn.invars
+                         if hasattr(getattr(v, "aval", None), "shape")) or 1)
+    return float(out_size)
+
+
+def _size(a) -> int:
+    n = 1
+    for d in getattr(a, "shape", ()):
+        n *= int(d)
+    return n
+
+
+def _eqn_bytes(eqn) -> float:
+    """Boundary traffic estimate: bytes of every (non-literal) operand and
+    result aval, once each."""
+    import jax
+
+    total = 0
+    for v in list(eqn.invars) + list(eqn.outvars):
+        if isinstance(v, jax.core.Literal):
+            continue
+        a = getattr(v, "aval", None)
+        if a is None or not hasattr(a, "shape"):
+            continue
+        total += _size(a) * int(getattr(a.dtype, "itemsize", 4))
+    return float(total)
+
+
+def estimate_cost(closed_or_raw) -> Dict[str, Any]:
+    """Pure jaxpr-walk static cost: flops, boundary bytes, per-primitive
+    flops breakdown. Scan bodies are multiplied by trip count; `cond`
+    branches are summed (a conservative upper bound); `while` bodies count
+    once (trip count is unknowable statically — documented, not guessed)."""
+    acc = _CostAcc()
+    _walk_cost(closed_or_raw, 1.0, acc)
+    by_prim = dict(sorted(acc.by_primitive.items(),
+                          key=lambda kv: (-kv[1], kv[0]))[:TOP_K_PRIMITIVES])
+    return {"est_flops": acc.flops, "est_bytes": acc.bytes,
+            "primitives": by_prim}
+
+
+def _walk_cost(j, mult: float, acc: _CostAcc) -> None:
+    for eqn in _raw(j).eqns:
+        prim = eqn.primitive.name
+        subs = program_mod._eqn_subjaxprs(eqn)
+        if subs:
+            sub_mult = mult
+            if prim == "scan":
+                sub_mult = mult * float(eqn.params.get("length", 1) or 1)
+            for s in subs:
+                _walk_cost(s, sub_mult, acc)
+            continue
+        f = _eqn_flops(eqn) * mult
+        acc.flops += f
+        acc.bytes += _eqn_bytes(eqn) * mult
+        acc.by_primitive[prim] = acc.by_primitive.get(prim, 0.0) + f
+
+
+def compiled_cost(traced) -> Optional[Dict[str, float]]:
+    """flops / bytes-accessed / peak-temp-bytes from XLA's own analysis of
+    the compiled executable (`.lower().compile()`, CPU — zero device
+    FLOPs). None when any stage of the AOT chain is unavailable; callers
+    fall back to `estimate_cost`."""
+    try:
+        compiled = traced.lower().compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        out = {"flops": float(analysis.get("flops", 0.0) or 0.0),
+               "bytes": float(analysis.get("bytes accessed", 0.0) or 0.0)}
+        mem = compiled.memory_analysis()
+        out["temp_bytes"] = float(
+            getattr(mem, "temp_size_in_bytes", 0) or 0)
+        return out
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------- snapshot
+
+def snapshot_entrypoint(ep: EntryPoint, compiled: bool = True
+                        ) -> Tuple[Optional[Dict[str, Any]], List[Finding]]:
+    """One entry point -> its baseline entry dict. A program that cannot
+    trace cannot be fingerprinted: that is a DP300 gate failure (the
+    `--trace` tier additionally classifies WHY it failed)."""
+    ctx, errs = program_mod.trace_entrypoint(ep)
+    if ctx is None:
+        first = errs[0] if errs else None
+        return None, [Finding(
+            path=first.path if first else "<entrypoint>",
+            line=first.line if first else 1, col=1, rule_id="DP300",
+            message=f"[{ep.name}] cannot fingerprint: program failed to "
+                    "trace abstractly"
+                    + (f" ({first.message.split(': ', 1)[-1][:160]})"
+                       if first else ""))]
+    entry: Dict[str, Any] = {
+        "fingerprint": fingerprint(ctx.jaxpr),
+        "interface": interface_record(ctx),
+        "cost": {},
+    }
+    est = estimate_cost(ctx.jaxpr)
+    entry["cost"]["est_flops"] = est["est_flops"]
+    entry["cost"]["est_bytes"] = est["est_bytes"]
+    entry["primitives"] = est["primitives"]
+    if compiled and getattr(ctx, "traced", None) is not None:
+        cc = compiled_cost(ctx.traced)
+        if cc is not None:
+            entry["cost"].update(cc)
+    entry["_path"] = ctx.path
+    entry["_line"] = ctx.line
+    return entry, []
+
+
+def build_baseline(eps: Iterable[EntryPoint], compiled: bool = True
+                   ) -> Tuple[Dict[str, Any], List[Finding]]:
+    """Snapshot every entry point into the baseline-file structure.
+    Findings (untraceable programs) make the build unusable for `update` —
+    a baseline with holes would make every future check vacuous there."""
+    entries: Dict[str, Any] = {}
+    findings: List[Finding] = []
+    for ep in eps:
+        snap, errs = snapshot_entrypoint(ep, compiled=compiled)
+        findings.extend(errs)
+        if snap is not None:
+            snap = {k: v for k, v in snap.items() if not k.startswith("_")}
+            entries[ep.name] = snap
+    import jax
+
+    data = {
+        "version": 1,
+        "jax": jax.__version__,
+        "tolerance_default": DEFAULT_TOLERANCE,
+        "entries": entries,
+    }
+    return data, findings
+
+
+def _normalize_numbers(x):
+    """Floats that are whole numbers become ints; the rest round to 6
+    significant-ish decimals — so regeneration diffs never churn on float
+    repr noise."""
+    if isinstance(x, dict):
+        return {k: _normalize_numbers(v) for k, v in x.items()}
+    if isinstance(x, list):
+        return [_normalize_numbers(v) for v in x]
+    if isinstance(x, float):
+        if x != x or x in (float("inf"), float("-inf")):
+            return str(x)
+        if abs(x) < 1e15 and float(x).is_integer():
+            return int(x)
+        return round(x, 6)
+    return x
+
+
+def dump_baseline(data: Mapping[str, Any]) -> str:
+    """Deterministic serialization: sorted keys, normalized numbers, one
+    trailing newline — `--baseline update` twice is byte-identical."""
+    return json.dumps(_normalize_numbers(dict(data)), sort_keys=True,
+                      indent=1) + "\n"
+
+
+def load_baseline(path: Optional[pathlib.Path] = None
+                  ) -> Optional[Dict[str, Any]]:
+    p = pathlib.Path(path) if path is not None else baseline_path()
+    try:
+        return json.loads(p.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return None
+
+
+def fingerprint_set_hash(entries: Mapping[str, Any]) -> str:
+    """One hash over the whole program set (sorted `name:fingerprint`
+    lines): the identity BENCH rows and an AOT executable cache key on."""
+    lines = [f"{name}:{entries[name].get('fingerprint', '?')}"
+             for name in sorted(entries)]
+    return _sha("\n".join(lines))
+
+
+def program_set_stamp(path: Optional[pathlib.Path] = None
+                      ) -> Optional[Dict[str, Any]]:
+    """BENCH stamp: {hash, entries, file} for the checked-in baseline, so
+    recorded perf numbers are attributable to an exact program set. None
+    when no baseline file exists (pre-baseline checkouts)."""
+    data = load_baseline(path)
+    if not data or not data.get("entries"):
+        return None
+    return {"hash": fingerprint_set_hash(data["entries"]),
+            "entries": len(data["entries"]),
+            "file": f"analysis/{BASELINE_FILENAME}"}
+
+
+# ------------------------------------------------------------------- check
+
+def allowed(name: str, rule_id: str,
+            allow: Optional[Dict[str, Dict[str, str]]] = None) -> bool:
+    """True when `ALLOWLIST` (or the per-call overlay) grants `rule_id`
+    for entry point `name` (keys are fnmatch globs)."""
+    for table in (ALLOWLIST, allow or {}):
+        for pattern, rules in table.items():
+            if fnmatch.fnmatchcase(name, pattern) and rule_id in rules:
+                return True
+    return False
+
+
+def tolerance_for(name: str, data: Mapping[str, Any]) -> float:
+    for pattern, tol in TOLERANCES.items():
+        if fnmatch.fnmatchcase(name, pattern):
+            return float(tol)
+    return float(data.get("tolerance_default", DEFAULT_TOLERANCE))
+
+
+def _fmt_count(x: float) -> str:
+    return f"{int(x):,}" if float(x).is_integer() else f"{x:,.1f}"
+
+
+#: metric name -> tolerance widening factor (temp memory is heuristic
+#: buffer assignment and jitters; flops/bytes are step functions).
+_COST_METRICS: Tuple[Tuple[str, float], ...] = (
+    ("flops", 1.0), ("bytes", 1.0), ("temp_bytes", TEMP_TOLERANCE_FACTOR),
+    ("est_flops", 1.0), ("est_bytes", 1.0),
+)
+
+
+def _cost_findings(name: str, live: Mapping[str, Any],
+                   base: Mapping[str, Any], tol: float,
+                   path: str, line: int) -> List[Finding]:
+    """DP301: the worst relative cost growth across the metrics both sides
+    carry, beyond tolerance; names the dominant regressing primitive."""
+    lcost, bcost = live.get("cost", {}), base.get("cost", {})
+    worst = None
+    for metric, widen in _COST_METRICS:
+        lv, bv = lcost.get(metric), bcost.get(metric)
+        if lv is None or bv is None or float(bv) <= 0:
+            continue
+        rel = float(lv) / float(bv) - 1.0
+        eff_tol = tol * widen
+        if rel > eff_tol and (worst is None or rel > worst[1]):
+            worst = (metric, rel, float(bv), float(lv), eff_tol)
+    if worst is None:
+        return []
+    metric, rel, bv, lv, eff_tol = worst
+    lprims = live.get("primitives", {}) or {}
+    bprims = base.get("primitives", {}) or {}
+    deltas = sorted(
+        ((p, float(lprims.get(p, 0.0)) - float(bprims.get(p, 0.0)))
+         for p in set(lprims) | set(bprims)),
+        key=lambda kv: (-kv[1], kv[0]))
+    dom = ""
+    if deltas and deltas[0][1] > 0:
+        dom = (f"; dominant primitive increase: {deltas[0][0]} "
+               f"(+{_fmt_count(deltas[0][1])} est flops)")
+    return [Finding(
+        path=path, line=line, col=1, rule_id="DP301",
+        message=f"[{name}] {metric} regressed {100.0 * rel:.1f}% over the "
+                f"baseline ({_fmt_count(bv)} -> {_fmt_count(lv)}; "
+                f"tolerance {100.0 * eff_tol:.0f}%){dom} — a perf "
+                "regression, or a baseline missing its --baseline update")]
+
+
+def _iface_findings(name: str, live: Mapping[str, Any],
+                    base: Mapping[str, Any], path: str,
+                    line: int) -> List[Finding]:
+    li, bi = live.get("interface", {}), base.get("interface", {})
+    if li.get("sha") == bi.get("sha"):
+        return []
+    drifted = []
+    for side in ("inputs", "outputs"):
+        ls, bs = li.get(side, {}), bi.get(side, {})
+        if ls.get("sha") != bs.get("sha") or ls.get("count") != bs.get("count"):
+            drifted.append(
+                f"{side} {bs.get('count', '?')} leaf/leaves "
+                f"{', '.join(bs.get('head', [])) or '?'}... -> "
+                f"{ls.get('count', '?')} {', '.join(ls.get('head', [])) or '?'}...")
+    if li.get("donated") != bi.get("donated"):
+        drifted.append(f"donated args {bi.get('donated')} -> "
+                       f"{li.get('donated')}")
+    return [Finding(
+        path=path, line=line, col=1, rule_id="DP304",
+        message=f"[{name}] interface drifted with an UNCHANGED program "
+                f"fingerprint ({'; '.join(drifted) or 'aval metadata'}) — "
+                "this poisons an AOT executable cache keyed on the "
+                "fingerprint; regenerate the baseline and audit the caller")]
+
+
+def _implied_buckets(base_name: str, live_names: Iterable[str],
+                     ladders: Mapping[str, int]) -> Optional[int]:
+    """Bucket count the program set implies for a wrapped entry point: an
+    explicitly registered ladder wins; otherwise the `name[...]` variant
+    count in the registry. None = nothing implied (unbucketed program)."""
+    if base_name in ladders:
+        return int(ladders[base_name])
+    variants = [n for n in live_names
+                if n.startswith(base_name + "[") and n.endswith("]")]
+    return len(variants) or None
+
+
+def check_entrypoints(
+        eps: Iterable[EntryPoint],
+        data: Mapping[str, Any],
+        budgets: Optional[Mapping[str, Optional[int]]] = None,
+        ladders: Optional[Mapping[str, int]] = None,
+        compiled: bool = True,
+        select: Optional[Sequence[str]] = None,
+        allow: Optional[Dict[str, Dict[str, str]]] = None) -> List[Finding]:
+    """Diff the live program set against the baseline: DP300-DP304.
+    `budgets`/`ladders` feed DP303 (from `entrypoints.declared_budgets()` /
+    `bucket_ladders()`); `compiled=False` skips XLA compilation and
+    compares the jaxpr-walk estimates only (the fast in-test mode)."""
+    entries: Mapping[str, Any] = data.get("entries", {})
+    live: Dict[str, Dict[str, Any]] = {}
+    findings: List[Finding] = []
+    anchors: Dict[str, Tuple[str, int]] = {}
+    for ep in eps:
+        snap, errs = snapshot_entrypoint(ep, compiled=compiled)
+        findings.extend(errs)
+        if snap is None:
+            continue
+        anchors[ep.name] = (snap.pop("_path"), snap.pop("_line"))
+        live[ep.name] = snap
+
+    for name in sorted(set(live) - set(entries)):
+        path, line = anchors.get(name, ("<entrypoint>", 1))
+        findings.append(Finding(
+            path=path, line=line, col=1, rule_id="DP302",
+            message=f"[{name}] entry point is registered in production but "
+                    "missing from the baseline — regenerate with "
+                    "--baseline update so the program set stays covered"))
+    for name in sorted(set(entries) - set(live)):
+        findings.append(Finding(
+            path="<baseline>", line=1, col=1, rule_id="DP302",
+            message=f"[{name}] entry point exists in the baseline but is "
+                    "no longer registered — removed program, or a "
+                    "registration hole; regenerate with --baseline update"))
+
+    for name in sorted(set(live) & set(entries)):
+        l, b = live[name], entries[name]
+        path, line = anchors.get(name, ("<entrypoint>", 1))
+        if l.get("fingerprint") != b.get("fingerprint"):
+            findings.append(Finding(
+                path=path, line=line, col=1, rule_id="DP300",
+                message=f"[{name}] program fingerprint drifted "
+                        f"({b.get('fingerprint', '?')} -> "
+                        f"{l.get('fingerprint', '?')}) but the baseline "
+                        "still records the old program — regenerate with "
+                        "--baseline update in the same PR"))
+        else:
+            findings.extend(_iface_findings(name, l, b, path, line))
+        findings.extend(_cost_findings(
+            name, l, b, tolerance_for(name, data), path, line))
+
+    for base_name in sorted(budgets or {}):
+        budget = (budgets or {})[base_name]
+        if budget is None:
+            continue
+        implied = _implied_buckets(base_name, live, ladders or {})
+        if implied is None or int(budget) == implied:
+            continue
+        path, line = ("<entrypoint>", 1)
+        for cand in ([base_name]
+                     + [n for n in sorted(live)
+                        if n.startswith(base_name + "[")]):
+            if cand in anchors:
+                path, line = anchors[cand]
+                break
+        findings.append(Finding(
+            path=path, line=line, col=1, rule_id="DP303",
+            message=f"[{base_name}] declared recompile_budget {budget} but "
+                    f"the registered program set implies {implied} "
+                    "bucket(s) — the watchdog budget and the bucket "
+                    "ladder drifted apart"))
+
+    out: List[Finding] = []
+    for f in findings:
+        name = f.message.split("]", 1)[0].lstrip("[")
+        if select is not None and f.rule_id not in select:
+            continue
+        if allowed(name, f.rule_id, allow):
+            continue
+        if program_mod._suppressed_in_source(f.path, f.line, f.rule_id):
+            continue
+        out.append(f)
+    return sorted(out)
+
+
+def check_summary(findings: List[Finding], entries: int,
+                  data: Mapping[str, Any],
+                  path: pathlib.Path) -> Dict[str, Any]:
+    """The machine-readable check result (`--baseline-report` writes it as
+    `baseline_check.json`; the report CLI renders it)."""
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
+    return {
+        "entries": entries,
+        "baseline_file": str(path),
+        "baseline_entries": len(data.get("entries", {})),
+        "fingerprint_set": fingerprint_set_hash(data.get("entries", {})),
+        "clean": not findings,
+        "findings_by_rule": dict(sorted(by_rule.items())),
+        "findings": [
+            {"rule": f.rule_id, "path": f.path, "line": f.line,
+             "message": f.message} for f in findings],
+    }

@@ -1,6 +1,6 @@
 """Analysis CLI: `python -m dorpatch_tpu.analysis [paths...]`.
 
-Three modes behind one exit contract (0 = clean, 1 = findings, 2 = usage
+Four modes behind one exit contract (0 = clean, 1 = findings, 2 = usage
 error; `run_tests.sh` gates on it):
 
 - **Lint** (default): the AST rules (DP101-DP107) over the package and
@@ -10,6 +10,13 @@ error; `run_tests.sh` gates on it):
   (`JAX_PLATFORMS=cpu`; zero device FLOPs). This mode imports jax and the
   production modules — it is the one analysis mode that is not
   backend-neutral to *import*, which is why it is opt-in.
+- **Baseline** (`--baseline check|update`): the program-baseline tier
+  (DP300-DP304) — fingerprints + static cost vectors for every registered
+  entry point, diffed against the checked-in `analysis/baselines.json`
+  (`check`, the gate) or regenerated deterministically (`update`, run in
+  the same PR as any intentional program change). `--baseline-cost
+  estimate` skips XLA compilation and compares the jaxpr-walk estimates
+  only (fast; the compiled flops/bytes/temp columns go unchecked).
 - **Fix** (`--fix [--diff]`): applies the mechanical DP106 rewriter
   (`fix.py`); `--diff` prints the unified diff without writing.
 
@@ -51,8 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m dorpatch_tpu.analysis",
         description="Static analysis for the dorpatch-tpu tree: AST rules "
-                    "DP101-DP107 (default) and the jaxpr-level program "
-                    "auditor DP200-DP206 (--trace); see --list-rules")
+                    "DP101-DP107 (default), the jaxpr-level program "
+                    "auditor DP200-DP206 (--trace), and the program-"
+                    "baseline drift gate DP300-DP304 (--baseline); see "
+                    "--list-rules")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories to lint (default: "
                         f"{' '.join(DEFAULT_PATHS)}; ignored under --trace)")
@@ -69,9 +78,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="audit the registered jit entry points at the "
                         "jaxpr level (DP2xx) instead of linting source")
     p.add_argument("--entrypoints", default="",
-                   help="--trace source override, `module:callable` "
-                        "returning a list of EntryPoints (default: the "
-                        "production registry)")
+                   help="--trace/--baseline source override, "
+                        "`module:callable` returning a list of EntryPoints "
+                        "(default: the production registry)")
+    p.add_argument("--baseline", nargs="?", const="check", default=None,
+                   choices=("check", "update"), metavar="{check,update}",
+                   help="program-baseline mode (DP300-DP304): `check` "
+                        "diffs the live fingerprints/costs against the "
+                        "checked-in baseline, `update` regenerates it "
+                        "deterministically (default mode: check)")
+    p.add_argument("--baseline-file", default="",
+                   help="baseline file override (default: the package's "
+                        "analysis/baselines.json)")
+    p.add_argument("--baseline-cost", choices=("compiled", "estimate"),
+                   default="compiled",
+                   help="cost source for --baseline: `compiled` runs "
+                        "XLA's cost_analysis per entry point (the gate "
+                        "default), `estimate` compares the pure jaxpr-walk "
+                        "estimates only (fast, compile-free)")
+    p.add_argument("--baseline-report", default="",
+                   help="with --baseline check: also write the machine-"
+                        "readable result as baseline_check.json into this "
+                        "directory (the telemetry report renders it)")
     p.add_argument("--fix", action="store_true",
                    help="apply the DP106 unused-import fixer to the "
                         "target paths (idempotent)")
@@ -92,10 +120,20 @@ def _trace_rule_table() -> List[tuple]:
     return rows
 
 
+def _baseline_rule_table() -> List[tuple]:
+    """(id, fixable, name, description) for the baseline rules — like the
+    trace table, importable without initializing any jax backend (the
+    baseline module keeps its jax imports inside function bodies)."""
+    from dorpatch_tpu.analysis.baseline import BASELINE_RULE_ROWS
+
+    return [(rid, False, name, desc) for rid, name, desc in BASELINE_RULE_ROWS]
+
+
 def list_rules(out=None) -> None:
     out = out if out is not None else sys.stdout
     rows = [(r.id, r.fixable, r.name, r.description) for r in all_rules()]
     rows += _trace_rule_table()
+    rows += _baseline_rule_table()
     for rid, fixable, name, description in sorted(rows):
         fix = "fixable" if fixable else "       "
         out.write(f"{rid}  {fix}  {name}: {description}\n")
@@ -113,27 +151,32 @@ def emit(findings: List[Finding], fmt: str, out=None) -> None:
             out.write(f.render() + "\n")
 
 
-def _parse_select(raw: str, trace_mode: bool) -> Optional[List[str]]:
-    """Validate --select against the rules of the mode actually running:
-    a cross-wing ID (`--select DP201` without `--trace`, or `--trace
-    --select DP106`) would run ZERO rules and turn a CI gate into a
-    vacuous pass — it must be a loud usage error instead."""
+def _parse_select(raw: str, mode: str) -> Optional[List[str]]:
+    """Validate --select against the rules of the mode actually running
+    (`mode` in lint/trace/baseline): a cross-wing ID (`--select DP201`
+    without `--trace`, or `--trace --select DP106`) would run ZERO rules
+    and turn a CI gate into a vacuous pass — it must be a loud usage
+    error instead."""
     if not raw:
         return None
     select = [s.strip().upper() for s in raw.split(",") if s.strip()]
+    from dorpatch_tpu.analysis.baseline import BASELINE_RULE_IDS
     from dorpatch_tpu.analysis.program import TRACE_RULE_IDS
 
-    ast_ids = {r.id for r in all_rules()} | {"DP000"}
-    trace_ids = set(TRACE_RULE_IDS)
-    known = trace_ids if trace_mode else ast_ids
-    bad = set(select) - known
+    wings = {
+        "lint": {r.id for r in all_rules()} | {"DP000"},
+        "trace": set(TRACE_RULE_IDS),
+        "baseline": set(BASELINE_RULE_IDS),
+    }
+    bad = set(select) - wings[mode]
     if bad:
-        other = sorted(bad & (ast_ids if trace_mode else trace_ids))
-        if other:
-            hint = (f" ({other} are AST rules; drop --trace)" if trace_mode
-                    else f" ({other} are trace rules; add --trace)")
-        else:
-            hint = ""
+        # Lint rules need the mode flag dropped; trace/baseline rules need
+        # theirs added (--baseline outranks --trace, so "add" suffices).
+        hints = [(f"{sorted(bad & ids)}: drop --{mode}" if m == "lint"
+                  else f"{sorted(bad & ids)}: add --{m}")
+                 for m, ids in wings.items()
+                 if m != mode and bad & ids]
+        hint = f" ({'; '.join(hints)})" if hints else ""
         sys.stderr.write(
             f"rule id(s) not runnable in this mode: {sorted(bad)}{hint}\n")
         return ["<usage-error>"]
@@ -154,10 +197,13 @@ def _run_fix(paths: List[str], diff_only: bool) -> int:
     return 0
 
 
-def _run_trace(select: Optional[List[str]], spec: str,
-               fmt: str) -> int:
+def _load_entrypoints(spec: str):
+    """Resolve the audit work list: the `--entrypoints module:callable`
+    override, or the production registry. Returns (eps, budgets, ladders,
+    uncovered) — budget/ladder ledgers are read AFTER enumeration so a
+    custom loader that registers ladders is honored too — or None on a
+    bad spec (usage error; message already on stderr)."""
     from dorpatch_tpu.analysis import entrypoints as ep_mod
-    from dorpatch_tpu.analysis import program
 
     if spec:
         mod_name, _, attr = spec.partition(":")
@@ -165,15 +211,27 @@ def _run_trace(select: Optional[List[str]], spec: str,
             loader = getattr(importlib.import_module(mod_name), attr)
         except (ImportError, AttributeError) as e:
             sys.stderr.write(f"cannot load --entrypoints {spec!r}: {e}\n")
-            return 2
+            return None
+        ep_mod.clear_entrypoints()  # stale ledgers must not leak into DP303
         eps = list(loader())
-        findings = program.audit_entrypoints(eps, select=select)
-        n_progs = len(eps)
+        uncovered: List[str] = []
     else:
         eps = ep_mod.production_entrypoints()
-        findings = program.audit_entrypoints(
-            eps, select=select, uncovered=ep_mod.uncovered_names())
-        n_progs = len(eps)
+        uncovered = ep_mod.uncovered_names()
+    return eps, ep_mod.declared_budgets(), ep_mod.bucket_ladders(), uncovered
+
+
+def _run_trace(select: Optional[List[str]], spec: str,
+               fmt: str) -> int:
+    from dorpatch_tpu.analysis import program
+
+    loaded = _load_entrypoints(spec)
+    if loaded is None:
+        return 2
+    eps, _, _, uncovered = loaded
+    findings = program.audit_entrypoints(eps, select=select,
+                                         uncovered=uncovered)
+    n_progs = len(eps)
     emit(findings, fmt)
     if findings:
         sys.stderr.write(
@@ -187,24 +245,97 @@ def _run_trace(select: Optional[List[str]], spec: str,
     return 0
 
 
+def _run_baseline(mode: str, select: Optional[List[str]], spec: str,
+                  fmt: str, cost: str, file_override: str,
+                  report_dir: str) -> int:
+    from dorpatch_tpu.analysis import baseline
+
+    loaded = _load_entrypoints(spec)
+    if loaded is None:
+        return 2
+    eps, budgets, ladders, _ = loaded
+    compiled = cost == "compiled"
+    path = (pathlib.Path(file_override) if file_override
+            else baseline.baseline_path())
+
+    if mode == "update":
+        data, findings = baseline.build_baseline(eps, compiled=compiled)
+        if findings:
+            # a baseline with holes would make every later check vacuous
+            # exactly where the gate is needed most — refuse to write one
+            emit(findings, fmt)
+            sys.stderr.write(
+                f"--baseline update: {len(findings)} entry point(s) failed "
+                "to trace; baseline NOT written\n")
+            return 1
+        text = baseline.dump_baseline(data)
+        try:
+            unchanged = path.read_text(encoding="utf-8") == text
+        except OSError:
+            unchanged = False
+        path.write_text(text, encoding="utf-8")
+        verb = "unchanged" if unchanged else "wrote"
+        sys.stderr.write(
+            f"--baseline update: {verb} {len(data['entries'])} entry "
+            f"point(s) -> {path}\n")
+        return 0
+
+    data = baseline.load_baseline(path)
+    if data is None:
+        sys.stderr.write(f"no readable baseline at {path}; run --baseline "
+                         "update first\n")
+        return 2
+    findings = baseline.check_entrypoints(
+        eps, data, budgets=budgets, ladders=ladders, compiled=compiled,
+        select=select)
+    emit(findings, fmt)
+    if report_dir:
+        summary = baseline.check_summary(findings, len(eps), data, path)
+        rd = pathlib.Path(report_dir)
+        rd.mkdir(parents=True, exist_ok=True)
+        (rd / "baseline_check.json").write_text(
+            json.dumps(summary, indent=2, sort_keys=True) + "\n",
+            encoding="utf-8")
+    if findings:
+        sys.stderr.write(
+            f"{len(findings)} baseline finding(s) across {len(eps)} entry "
+            "point(s). An intentional program/cost change must land its "
+            "`--baseline update` in the same PR; suppress a deliberate "
+            "residual with `# noqa: DP3xx` on the program's def line or a "
+            "reasoned analysis.baseline.ALLOWLIST entry.\n")
+        return 1
+    sys.stderr.write(
+        f"baseline check: {len(eps)} entry point(s) match "
+        f"{path.name} ({len(data.get('entries', {}))} baselined)\n")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
         list_rules()
         return 0
-    select = _parse_select(args.select, trace_mode=args.trace)
+    # --baseline outranks --trace so `dorpatch-audit --baseline` (which
+    # prepends --trace) reaches the baseline tier
+    mode = ("baseline" if args.baseline
+            else "trace" if args.trace else "lint")
+    select = _parse_select(args.select, mode)
     if select == ["<usage-error>"]:
         return 2
     if args.diff and not args.fix:
         sys.stderr.write("--diff requires --fix\n")
         return 2
-    if args.fix and args.trace:
-        sys.stderr.write("--fix and --trace are separate modes; run them "
-                         "as two invocations\n")
+    if args.fix and (args.trace or args.baseline):
+        sys.stderr.write("--fix and --trace/--baseline are separate modes; "
+                         "run them as two invocations\n")
         return 2
     paths = args.paths or default_paths()
     if args.fix:
         return _run_fix(paths, args.diff)
+    if args.baseline:
+        return _run_baseline(args.baseline, select, args.entrypoints,
+                             args.format, args.baseline_cost,
+                             args.baseline_file, args.baseline_report)
     if args.trace:
         return _run_trace(select, args.entrypoints, args.format)
     try:
@@ -228,7 +359,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 def audit_main(argv: Optional[List[str]] = None) -> int:
     """`dorpatch-audit` console script: the trace audit as a first-class
-    command (`dorpatch-audit` == `python -m dorpatch_tpu.analysis --trace`)."""
+    command (`dorpatch-audit` == `python -m dorpatch_tpu.analysis --trace`).
+    `dorpatch-audit --baseline [check|update]` reaches the baseline tier:
+    --baseline outranks the prepended --trace."""
     return main(["--trace"] + list(argv if argv is not None else sys.argv[1:]))
 
 

@@ -61,6 +61,13 @@ class EntryPoint:
 _REGISTRY: Dict[str, EntryPoint] = {}
 #: every name seen through a timed_first_call wrap (discoverability ledger)
 _WRAPPED: Dict[str, Callable] = {}
+#: base name -> the recompile_budget its timed_first_call wrap declared
+#: (None = undeclared); feeds the baseline tier's DP303 consistency check
+_BUDGETS: Dict[str, Optional[int]] = {}
+#: base name -> the bucket-ladder length the constructing subsystem
+#: registered (`register_bucket_ladder`); the ground truth DP303 compares
+#: declared budgets against
+_LADDERS: Dict[str, int] = {}
 
 
 def abstractify(tree):
@@ -138,9 +145,31 @@ def uncovered_names() -> List[str]:
     return out
 
 
+def register_bucket_ladder(name: str, sizes) -> None:
+    """Record the bucket ladder a subsystem actually builds for a wrapped
+    entry point (e.g. the defense row programs' `row_bucket_sizes`). DP303
+    checks the wrap's declared `recompile_budget` against this count; for
+    names with no explicit ladder the `name[...]`-variant count in the
+    registry is the fallback ground truth."""
+    _LADDERS[name] = len(tuple(sizes))
+
+
+def declared_budgets() -> Dict[str, Optional[int]]:
+    """base name -> `recompile_budget` declared at its timed_first_call
+    wrap (captured through the recorder's `on_budget` hook)."""
+    return dict(_BUDGETS)
+
+
+def bucket_ladders() -> Dict[str, int]:
+    """base name -> explicitly registered bucket-ladder length."""
+    return dict(_LADDERS)
+
+
 def clear_entrypoints() -> None:
     _REGISTRY.clear()
     _WRAPPED.clear()
+    _BUDGETS.clear()
+    _LADDERS.clear()
 
 
 class _CaptureRecorder:
@@ -150,6 +179,12 @@ class _CaptureRecorder:
 
     def on_wrap(self, name: str, fn: Callable) -> None:
         _WRAPPED[name] = fn
+
+    def on_budget(self, name: str, budget: Optional[int]) -> None:
+        # last-write-wins: a name wrapped twice (e.g. the defense tables
+        # re-wrapped by the serve layer) keeps its most recent declaration,
+        # matching which wrapper is actually live
+        _BUDGETS[name] = budget
 
     def on_call(self, name: str, fn: Callable, args, kwargs) -> None:
         _WRAPPED.setdefault(name, fn)
@@ -266,6 +301,10 @@ def _enumerate_defense(apply_fn, params) -> None:
             (w, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
         mask_idx = jax.ShapeDtypeStruct((w,), jnp.int32)
         register_entrypoint(d._rows, (params_abs, imgs_g, mask_idx))
+        # the row program's declared recompile_budget is its bucket-ladder
+        # length; record the ladder so the baseline tier (DP303) can check
+        # the declaration against the ground truth
+        register_bucket_ladder(d._rows._name, d.row_bucket_sizes)
 
 
 def _enumerate_incremental() -> None:
@@ -303,6 +342,9 @@ def _enumerate_incremental() -> None:
         w = int(d.row_bucket_sizes[0])
         imgs_g = jax.ShapeDtypeStruct(
             (w, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+        register_bucket_ladder(d._rows._name, d.row_bucket_sizes)
+        if d._rows_incr is not None:
+            register_bucket_ladder(d._rows_incr._name, d.row_bucket_sizes)
         for name, fn, kind in d.pruned_programs():
             if kind == "imgs":
                 register_entrypoint(fn, (params_abs, imgs), name=name)
@@ -342,6 +384,10 @@ def _enumerate_serve(apply_fn, params) -> None:
         defense_cfg=DefenseConfig(ratios=(0.1,), chunk_size=64))
     for name, fn, args in svc.trace_entrypoints():
         register_entrypoint(fn, args, name=name)
+    for d in svc.defenses:
+        register_bucket_ladder(d._rows._name, d.row_bucket_sizes)
+        if d._rows_incr is not None:
+            register_bucket_ladder(d._rows_incr._name, d.row_bucket_sizes)
 
 
 def _enumerate_sharded_ops() -> None:

@@ -102,6 +102,10 @@ class ProgramContext:
     args_info: Any                   # Traced.args_info (donation), or None
     path: str
     line: int
+    #: the jit AOT `Traced` object when the entry point exposes `.trace()`
+    #: (None for bare callables) — the baseline tier's bridge to
+    #: `.lower().compile().cost_analysis()`
+    traced: Any = None
 
 
 class TraceRule:
@@ -532,6 +536,7 @@ def trace_entrypoint(ep: EntryPoint) -> Tuple[Optional[ProgramContext],
     import jax
 
     path, line = _source_location(ep.fn)
+    traced = None
     try:
         if hasattr(ep.fn, "trace"):
             traced = ep.fn.trace(*ep.args, **ep.kwargs)
@@ -560,7 +565,7 @@ def trace_entrypoint(ep: EntryPoint) -> Tuple[Optional[ProgramContext],
             message=f"[{ep.name}] failed to trace abstractly: {first}")]
     return ProgramContext(name=ep.name, fn=ep.fn, jaxpr=jaxpr, args=ep.args,
                           out_avals_tree=out_avals_tree, args_info=args_info,
-                          path=path, line=line), []
+                          path=path, line=line, traced=traced), []
 
 
 def audit_entrypoint(ep: EntryPoint,

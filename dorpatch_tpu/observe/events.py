@@ -352,4 +352,9 @@ def timed_first_call(fn, name: str, clock=time.perf_counter,
     recorder = _ENTRYPOINT_RECORDER
     if recorder is not None:
         recorder.on_wrap(name, fn)
+        # optional hook (baseline tier's DP303): the declared recompile
+        # budget is wrapper metadata, invisible through on_wrap's raw fn
+        on_budget = getattr(recorder, "on_budget", None)
+        if on_budget is not None:
+            on_budget(name, recompile_budget)
     return _FirstCallTimer(fn, name, clock, recompile_budget)

@@ -178,6 +178,7 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
                         peak_flops=float(tele["peak_flops"]))
 
     serve = _summarize_serve(ev)
+    baseline = _load_baseline_check(result_dir)
 
     metrics_by_attempt: Dict[str, int] = {}
     for m in metrics:
@@ -227,6 +228,7 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
         },
         "mfu": mfu,
         "serve": serve,
+        "baseline": baseline,
         "peak_device_bytes": peak_mem or None,
         "heartbeats": summarize_heartbeats(result_dir,
                                            stall_factor=stall_factor),
@@ -294,6 +296,19 @@ def _summarize_serve(ev: List[dict]) -> Optional[dict]:
         "certify_prune_rate": round(1.0 - fwd / fwd_exh, 4)
         if fwd and fwd_exh else None,
     }
+
+
+def _load_baseline_check(result_dir: str) -> Optional[dict]:
+    """The program-baseline gate's machine-readable result, when a
+    `--baseline check --baseline-report <dir>` run dropped one next to the
+    telemetry (`baseline_check.json`). None when absent — results dirs
+    predating the baseline tier render unchanged."""
+    try:
+        with open(os.path.join(result_dir, "baseline_check.json")) as fh:
+            out = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return out if isinstance(out, dict) else None
 
 
 def _fmt_bytes(n: int) -> str:
@@ -399,6 +414,20 @@ def format_report(s: dict) -> str:
                 incr = f" ({fe} full-forward equivalents, incremental)"
             add(f"  certify forwards: "
                 f"{sv['certify_forwards_per_request']}/request{incr}{prune}")
+
+    bl = s.get("baseline")
+    if bl:
+        add("-- program baseline --")
+        verdict = "clean" if bl.get("clean") else "DRIFTED"
+        add(f"  {verdict}: {bl.get('entries', '?')} entry point(s) vs "
+            f"{bl.get('baseline_entries', '?')} baselined "
+            f"(set {bl.get('fingerprint_set', '?')})")
+        by_rule = bl.get("findings_by_rule") or {}
+        if by_rule:
+            add("  findings: "
+                + ", ".join(f"{k}: {v}" for k, v in sorted(by_rule.items())))
+            for f in (bl.get("findings") or [])[:8]:
+                add(f"  {f.get('rule', '?')} {f.get('message', '')[:110]}")
 
     add("-- heartbeats --")
     if not s["heartbeats"]:

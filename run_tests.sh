@@ -19,6 +19,17 @@ timeout -k 10 120 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -m dorpatch_tpu.analysis --trace || exit $?
 echo "program audit (--trace): OK"
+# Gate 3: the program-baseline drift gate (DP300-DP304) — fingerprints +
+# static cost vectors for every registered entry point, diffed against the
+# checked-in analysis/baselines.json (same 8-device virtual mesh the
+# baseline was generated under). Compiled-cost mode runs XLA's cost
+# analysis per program (~90 s warm); 420 s is the cold-machine budget. An
+# intentional program change regenerates the file in the same PR:
+#   python -m dorpatch_tpu.analysis --baseline update
+timeout -k 10 420 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m dorpatch_tpu.analysis --baseline check || exit $?
+echo "program baseline (--baseline check): OK"
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@" \
   || exit $?
 # Smoke: the offline telemetry report CLI must render the checked-in fixture

@@ -1,0 +1,88 @@
+"""Fixture entry points for the program-baseline tier (DP300-DP304).
+
+Cheap, dependency-free jit programs exercising the fingerprint and cost
+machinery: a reference program, a local-rename twin (identical canonical
+jaxpr), a literal-change twin (different fingerprint), a planted +~20%
+FLOPs regression twin (extra matmul — DP301 with `dot_general` dominant),
+and a donation twin (interface drift with an unchanged body — DP304).
+
+`clean_entrypoints` / `regressed_entrypoints` are `--entrypoints`
+loaders for CLI-level tests: both register the same names, so checking
+`regressed` against a baseline built from `clean` yields pure rule
+findings with no DP302 set-drift noise.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu.analysis.entrypoints import EntryPoint, abstractify
+
+_X = abstractify(jnp.zeros((8, 16), jnp.float32))
+_W = abstractify(jnp.zeros((16, 16), jnp.float32))
+
+
+@jax.jit
+def _ref(x, w):
+    y = jnp.tanh(x @ w)
+    return y.sum(axis=-1)
+
+
+@jax.jit
+def _renamed(a, b):  # same program, different python locals/arg names
+    hidden = jnp.tanh(a @ b)
+    return hidden.sum(axis=-1)
+
+
+@jax.jit
+def _literal(x, w):  # one literal changed: 2.0 scale on the activation
+    y = jnp.tanh(x @ w) * 2.0
+    return y.sum(axis=-1)
+
+
+@jax.jit
+def _regressed(x, w):  # planted regression: a second matmul (~+100% flops)
+    y = jnp.tanh((x @ w) @ w)
+    return y.sum(axis=-1)
+
+
+def _step(x, w):
+    return x + w, w
+
+
+_carry = jax.jit(_step)
+_carry_donated = jax.jit(_step, donate_argnums=(0,))
+
+
+def ref_entrypoint(name="fx.base.ref"):
+    return EntryPoint(name=name, fn=_ref, args=(_X, _W))
+
+
+def renamed_entrypoint(name="fx.base.ref"):
+    return EntryPoint(name=name, fn=_renamed, args=(_X, _W))
+
+
+def literal_entrypoint(name="fx.base.ref"):
+    return EntryPoint(name=name, fn=_literal, args=(_X, _W))
+
+
+def regressed_entrypoint(name="fx.base.ref"):
+    return EntryPoint(name=name, fn=_regressed, args=(_X, _W))
+
+
+def carry_entrypoint(name="fx.base.carry"):
+    return EntryPoint(name=name, fn=_carry, args=(_X, _X))
+
+
+def carry_donated_entrypoint(name="fx.base.carry"):
+    return EntryPoint(name=name, fn=_carry_donated, args=(_X, _X))
+
+
+def clean_entrypoints():
+    """--entrypoints loader: the reference program set."""
+    return [ref_entrypoint(), carry_entrypoint()]
+
+
+def regressed_entrypoints():
+    """--entrypoints loader: same names, one planted +~100% FLOPs
+    regression (DP301) and one donation flip (DP304)."""
+    return [regressed_entrypoint(), carry_donated_entrypoint()]

@@ -1,0 +1,411 @@
+"""The program-baseline tier (analysis/baseline.py, DP300-DP304):
+fingerprint stability/sensitivity, the interface-vs-body split, the static
+cost model and the planted-regression gate, deterministic/idempotent
+`--baseline update`, suppression semantics (noqa + ALLOWLIST), the shipped
+tree checking clean against the shipped baselines.json, and the CLI exit
+contract in-process and via subprocess."""
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import jax.numpy as jnp
+
+from dorpatch_tpu.analysis import baseline
+from dorpatch_tpu.analysis import entrypoints as ep_mod
+from dorpatch_tpu.analysis.cli import main as cli_main
+from dorpatch_tpu.analysis.entrypoints import (
+    EntryPoint,
+    abstractify,
+    clear_entrypoints,
+    register_bucket_ladder,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "analysis"
+sys.path.insert(0, str(FIXTURES))
+
+import baseline_programs  # noqa: E402  (fixture module, see path insert)
+
+
+def rule_ids(findings):
+    return sorted({f.rule_id for f in findings})
+
+
+def snap(ep, compiled=False):
+    entry, errs = baseline.snapshot_entrypoint(ep, compiled=compiled)
+    assert entry is not None, errs
+    return entry
+
+
+# ---------- fingerprint stability / sensitivity ----------
+
+def test_fingerprint_stable_across_reenumeration():
+    """The same program registered twice through a full registry
+    clear+re-enumerate cycle (a fresh process, as far as the registry is
+    concerned) fingerprints identically — jit object identity, wrapper
+    state, and registration order must not leak into the hash."""
+    prints = []
+    for _ in range(2):
+        clear_entrypoints()
+        eps = baseline_programs.clean_entrypoints()
+        data, errs = baseline.build_baseline(eps, compiled=False)
+        assert not errs
+        prints.append(baseline.dump_baseline(data))
+    clear_entrypoints()
+    assert prints[0] == prints[1]
+
+
+def test_fingerprint_ignores_local_rename():
+    a = snap(baseline_programs.ref_entrypoint())
+    b = snap(baseline_programs.renamed_entrypoint())
+    assert a["fingerprint"] == b["fingerprint"]
+
+
+def test_fingerprint_changes_on_literal():
+    a = snap(baseline_programs.ref_entrypoint())
+    b = snap(baseline_programs.literal_entrypoint())
+    assert a["fingerprint"] != b["fingerprint"]
+
+
+def test_fingerprint_changes_on_eqn():
+    a = snap(baseline_programs.ref_entrypoint())
+    b = snap(baseline_programs.regressed_entrypoint())
+    assert a["fingerprint"] != b["fingerprint"]
+
+
+def test_interface_split_from_body():
+    """Donation is interface, not body: flipping donate_argnums keeps the
+    fingerprint and changes only the interface sha — the split that makes
+    DP304 (interface drift with unchanged fingerprint) reachable."""
+    a = snap(baseline_programs.carry_entrypoint())
+    b = snap(baseline_programs.carry_donated_entrypoint())
+    assert a["fingerprint"] == b["fingerprint"]
+    assert a["interface"]["sha"] != b["interface"]["sha"]
+    assert b["interface"]["donated"] == [0]
+
+
+# ---------- cost model ----------
+
+def test_estimator_counts_matmul_flops():
+    ctx_cost = snap(baseline_programs.ref_entrypoint())["cost"]
+    # (8,16)@(16,16): 2*8*16*16 = 4096 matmul flops dominate
+    assert ctx_cost["est_flops"] >= 4096
+    prims = snap(baseline_programs.ref_entrypoint())["primitives"]
+    assert max(prims, key=prims.get) == "dot_general"
+
+
+def test_compiled_cost_available():
+    entry = snap(baseline_programs.ref_entrypoint(), compiled=True)
+    assert entry["cost"]["flops"] > 0
+    assert entry["cost"]["bytes"] > 0
+    assert "temp_bytes" in entry["cost"]
+
+
+# ---------- the drift rules ----------
+
+def _clean_baseline():
+    data, errs = baseline.build_baseline(
+        baseline_programs.clean_entrypoints(), compiled=False)
+    assert not errs
+    return data
+
+
+def test_dp301_planted_regression_names_primitive():
+    """Acceptance: the planted extra matmul trips DP301, naming the entry
+    point and the dominant regressing primitive."""
+    findings = baseline.check_entrypoints(
+        baseline_programs.regressed_entrypoints(), _clean_baseline(),
+        compiled=False)
+    dp301 = [f for f in findings if f.rule_id == "DP301"]
+    assert len(dp301) == 1
+    assert "[fx.base.ref]" in dp301[0].message
+    assert "dot_general" in dp301[0].message
+
+
+def test_dp304_donation_flip():
+    findings = baseline.check_entrypoints(
+        baseline_programs.regressed_entrypoints(), _clean_baseline(),
+        compiled=False)
+    dp304 = [f for f in findings if f.rule_id == "DP304"]
+    assert len(dp304) == 1
+    assert "[fx.base.carry]" in dp304[0].message
+    assert "donated" in dp304[0].message
+
+
+def test_dp300_fires_once_per_drifted_program():
+    live = [baseline_programs.literal_entrypoint(),
+            baseline_programs.carry_entrypoint()]
+    findings = baseline.check_entrypoints(live, _clean_baseline(),
+                                          compiled=False)
+    assert [f.rule_id for f in findings if f.rule_id == "DP300"] == ["DP300"]
+
+
+def test_dp302_added_and_removed():
+    data = _clean_baseline()
+    live = [baseline_programs.ref_entrypoint(),
+            baseline_programs.carry_entrypoint(name="fx.base.carry2")]
+    findings = baseline.check_entrypoints(live, data, compiled=False)
+    msgs = {f.rule_id: f.message for f in findings}
+    assert rule_ids(findings) == ["DP302"]
+    assert sum(1 for f in findings if f.rule_id == "DP302") == 2
+    assert "fx.base.carry2" in str(msgs)
+
+
+def test_dp303_budget_vs_variants_and_ladder():
+    eps = [baseline_programs.ref_entrypoint(name="fx.rows[w4]"),
+           baseline_programs.ref_entrypoint(name="fx.rows[w8]")]
+    data, errs = baseline.build_baseline(eps, compiled=False)
+    assert not errs
+    # variant count (2) vs declared budget: mismatch fires, match is quiet
+    bad = baseline.check_entrypoints(eps, data, budgets={"fx.rows": 3},
+                                     compiled=False)
+    assert rule_ids(bad) == ["DP303"]
+    assert "implies 2 bucket(s)" in bad[0].message
+    assert not baseline.check_entrypoints(eps, data, budgets={"fx.rows": 2},
+                                          compiled=False)
+    # an explicit ladder outranks the variant count
+    assert not baseline.check_entrypoints(
+        eps, data, budgets={"fx.rows": 3}, ladders={"fx.rows": 3},
+        compiled=False)
+    # undeclared budget / unbucketed name: nothing to check
+    assert not baseline.check_entrypoints(
+        eps, data, budgets={"fx.rows": None, "fx.orphan": 5}, compiled=False)
+
+
+def test_bucket_ladder_registry_round_trip():
+    clear_entrypoints()
+    try:
+        register_bucket_ladder("fx.rows", (4, 8, 16))
+        assert ep_mod.bucket_ladders() == {"fx.rows": 3}
+    finally:
+        clear_entrypoints()
+
+
+def test_on_budget_hook_captures_declared_budget():
+    from dorpatch_tpu import observe
+
+    clear_entrypoints()
+    try:
+        with ep_mod.capture_entrypoints():
+            observe.timed_first_call(baseline_programs._ref, "fx.budgeted",
+                                     recompile_budget=7)
+        assert ep_mod.declared_budgets() == {"fx.budgeted": 7}
+    finally:
+        clear_entrypoints()
+
+
+# ---------- suppression: allowlist + source noqa ----------
+
+def test_allowlist_overlay_suppresses_dp301():
+    findings = baseline.check_entrypoints(
+        baseline_programs.regressed_entrypoints(), _clean_baseline(),
+        compiled=False,
+        allow={"fx.base.*": {"DP301": "fixture", "DP304": "fixture"}})
+    assert "DP301" not in rule_ids(findings)
+    assert "DP304" not in rule_ids(findings)
+
+
+def test_noqa_on_def_line_suppresses_dp300(tmp_path):
+    mod = tmp_path / "noqa_base_prog.py"
+    mod.write_text(textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def prog(x):  # noqa: DP300 — fixture: drift here is deliberate
+            return jnp.tanh(x) * 3.0
+    """), encoding="utf-8")
+    sys.path.insert(0, str(tmp_path))
+    try:
+        import noqa_base_prog
+        args = (abstractify(jnp.zeros((4,), jnp.float32)),)
+        ep = EntryPoint(name="fx.noqa", fn=noqa_base_prog.prog, args=args)
+        data, _ = baseline.build_baseline([ep], compiled=False)
+        data["entries"]["fx.noqa"]["fingerprint"] = "0" * 16  # plant drift
+        findings = baseline.check_entrypoints([ep], data, compiled=False)
+        assert "DP300" not in rule_ids(findings)
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("noqa_base_prog", None)
+
+
+def test_select_filters_rules():
+    findings = baseline.check_entrypoints(
+        baseline_programs.regressed_entrypoints(), _clean_baseline(),
+        compiled=False, select=["DP304"])
+    assert rule_ids(findings) == ["DP304"]
+
+
+# ---------- update determinism / idempotency ----------
+
+def test_update_idempotent_byte_identical(tmp_path):
+    path = tmp_path / "baselines.json"
+    argv = ["--baseline", "update", "--baseline-file", str(path),
+            "--baseline-cost", "estimate",
+            "--entrypoints", "baseline_programs:clean_entrypoints"]
+    assert cli_main(list(argv)) == 0
+    first = path.read_bytes()
+    assert cli_main(list(argv)) == 0
+    assert path.read_bytes() == first
+    data = json.loads(first)
+    assert sorted(data["entries"]) == ["fx.base.carry", "fx.base.ref"]
+    assert data["version"] == 1
+
+
+def test_update_refuses_untraceable(tmp_path):
+    import trace_programs
+
+    path = tmp_path / "baselines.json"
+    assert cli_main(["--baseline", "update", "--baseline-file", str(path),
+                     "--baseline-cost", "estimate",
+                     "--entrypoints", "trace_programs:bad_entrypoints"]) == 1
+    assert not path.exists(), "a holed baseline must never be written"
+
+
+# ---------- CLI exit contract ----------
+
+def test_cli_check_exit_codes(tmp_path, capsys):
+    path = tmp_path / "baselines.json"
+    assert cli_main(["--baseline", "update", "--baseline-file", str(path),
+                     "--baseline-cost", "estimate",
+                     "--entrypoints",
+                     "baseline_programs:clean_entrypoints"]) == 0
+    capsys.readouterr()
+    assert cli_main(["--baseline", "check", "--baseline-file", str(path),
+                     "--baseline-cost", "estimate",
+                     "--entrypoints",
+                     "baseline_programs:clean_entrypoints"]) == 0
+    capsys.readouterr()
+    rc = cli_main(["--baseline", "check", "--baseline-file", str(path),
+                   "--baseline-cost", "estimate", "--format", "json",
+                   "--entrypoints",
+                   "baseline_programs:regressed_entrypoints"])
+    assert rc == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    objs = [json.loads(line) for line in out if line]
+    # the planted extra matmul is also a program change, so the fingerprint
+    # drift (DP300) rides along with the cost regression (DP301)
+    assert {o["rule"] for o in objs} == {"DP300", "DP301", "DP304"}
+    assert all("message" in o and "path" in o for o in objs)
+
+
+def test_cli_check_select_and_usage_errors(tmp_path, capsys):
+    path = tmp_path / "baselines.json"
+    assert cli_main(["--baseline", "update", "--baseline-file", str(path),
+                     "--baseline-cost", "estimate",
+                     "--entrypoints",
+                     "baseline_programs:clean_entrypoints"]) == 0
+    capsys.readouterr()
+    rc = cli_main(["--baseline", "check", "--baseline-file", str(path),
+                   "--baseline-cost", "estimate", "--select", "DP304",
+                   "--entrypoints",
+                   "baseline_programs:regressed_entrypoints"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "DP304" in out and "DP301" not in out
+    # cross-wing select is a usage error, not a vacuous pass
+    assert cli_main(["--baseline", "check", "--select", "DP201"]) == 2
+    assert cli_main(["--trace", "--select", "DP300"]) == 2
+    # missing baseline file is a usage error
+    assert cli_main(["--baseline", "check", "--baseline-file",
+                     str(tmp_path / "nope.json"), "--baseline-cost",
+                     "estimate", "--entrypoints",
+                     "baseline_programs:clean_entrypoints"]) == 2
+    # bad loader spec
+    assert cli_main(["--baseline", "check",
+                     "--entrypoints", "no.such.module:x"]) == 2
+
+
+def test_cli_baseline_outranks_trace_flag(tmp_path, capsys):
+    """`dorpatch-audit --baseline ...` prepends --trace; the baseline mode
+    must win so the console script reaches the baseline tier."""
+    from dorpatch_tpu.analysis.cli import audit_main
+
+    path = tmp_path / "baselines.json"
+    assert audit_main(["--baseline", "update", "--baseline-file", str(path),
+                       "--baseline-cost", "estimate",
+                       "--entrypoints",
+                       "baseline_programs:clean_entrypoints"]) == 0
+    assert path.exists()
+
+
+def test_cli_baseline_report_written(tmp_path, capsys):
+    path = tmp_path / "baselines.json"
+    rd = tmp_path / "results"
+    assert cli_main(["--baseline", "update", "--baseline-file", str(path),
+                     "--baseline-cost", "estimate",
+                     "--entrypoints",
+                     "baseline_programs:clean_entrypoints"]) == 0
+    rc = cli_main(["--baseline", "check", "--baseline-file", str(path),
+                   "--baseline-cost", "estimate",
+                   "--baseline-report", str(rd),
+                   "--entrypoints",
+                   "baseline_programs:regressed_entrypoints"])
+    assert rc == 1
+    summary = json.loads((rd / "baseline_check.json").read_text())
+    assert summary["clean"] is False
+    assert summary["findings_by_rule"] == {"DP300": 1, "DP301": 1,
+                                           "DP304": 1}
+    assert summary["fingerprint_set"]
+    # the offline telemetry report renders the section
+    from dorpatch_tpu.observe import report as report_mod
+
+    s = report_mod.summarize(str(rd))
+    assert s["baseline"]["clean"] is False
+    text = report_mod.format_report(s)
+    assert "-- program baseline --" in text
+    assert "DRIFTED" in text and "DP301" in text
+
+
+def test_list_rules_includes_baseline_rows(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("DP300", "DP301", "DP302", "DP303", "DP304"):
+        assert rid in out
+
+
+# ---------- the shipped tree vs the shipped baseline ----------
+
+def test_shipped_baseline_covers_all_entry_points():
+    """Acceptance: the checked-in baselines.json names exactly the
+    registered production set — zero uncovered names either way —
+    and the live fingerprints match (estimate mode: compile-free)."""
+    data = baseline.load_baseline()
+    assert data is not None, "analysis/baselines.json missing"
+    eps = ep_mod.production_entrypoints()
+    assert sorted(data["entries"]) == sorted(e.name for e in eps)
+    findings = baseline.check_entrypoints(
+        eps, data, budgets=ep_mod.declared_budgets(),
+        ladders=ep_mod.bucket_ladders(), compiled=False)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_program_set_stamp_matches_shipped_baseline():
+    stamp = baseline.program_set_stamp()
+    assert stamp is not None
+    data = baseline.load_baseline()
+    assert stamp["entries"] == len(data["entries"])
+    assert stamp["hash"] == baseline.fingerprint_set_hash(data["entries"])
+    assert stamp["file"] == "analysis/baselines.json"
+
+
+@pytest.mark.slow
+def test_cli_baseline_check_production_subprocess(tmp_path):
+    """The run_tests.sh gate end-to-end: `--baseline check` audits the
+    production registry against the shipped baseline in a fresh process
+    (compiled-cost mode, XLA cost analysis per program) and exits 0."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dorpatch_tpu.analysis", "--baseline",
+         "check"],
+        cwd=REPO, capture_output=True, text=True, timeout=420,
+        env={"PATH": "/usr/bin:/bin:/usr/local/bin",
+             "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+             "HOME": str(tmp_path)})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "baseline check" in proc.stderr
