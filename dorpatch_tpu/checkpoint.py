@@ -18,10 +18,41 @@ arrays come back with the template's sharding/placement.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, NamedTuple, Optional
 
 import jax
+
+
+def atomic_write_json(path: str, obj: Any) -> None:
+    """Crash-safe JSON write: same-directory `*.tmp` + `os.replace`.
+
+    A reader never observes a half-written file — a crash mid-write leaves
+    either the previous content or the new one (plus at worst a stray tmp
+    file). The tmp name carries the pid so concurrent writers on the same
+    path never clobber each other's in-flight tmp; the `os.replace` itself
+    is the single atomic commit point. This is the persistence primitive
+    for every mutable host-side JSON record that must survive SIGKILL
+    (`farm/queue.py`'s job/lease state, the sweep's resume bookkeeping)."""
+    path = os.path.abspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(obj, fh, indent=1, sort_keys=True, default=float)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def load_json(path: str, default: Any = None) -> Any:
+    """Read a JSON file, returning `default` when it is missing, unreadable,
+    or truncated/corrupt — the tolerant counterpart of `atomic_write_json`
+    (crash-recovery readers must classify bad state, never die on it)."""
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return default
 
 
 class CarryCheckpoint(NamedTuple):
@@ -69,21 +100,46 @@ class CarryCheckpointer:
         )
         if fingerprint is not None:
             for step in list(self._mgr.all_steps()):
-                meta = self._mgr.restore(
-                    step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
-                )["meta"]
-                if meta.get("fingerprint") != fingerprint:
+                meta = self._read_meta(step)
+                if meta is None or meta.get("fingerprint") != fingerprint:
                     import warnings
 
+                    got = None if meta is None else meta.get("fingerprint")
                     warnings.warn(
                         f"carry snapshot {step} in {self.directory} has "
-                        f"fingerprint {meta.get('fingerprint')!r} != this "
+                        f"fingerprint {got!r} != this "
                         f"run's {fingerprint!r}; deleting it (it could "
                         "shadow restores and block saves)")
-                    self._mgr.delete(step)
+                    self._delete_quietly(step)
+
+    def _read_meta(self, step: int) -> Optional[dict]:
+        """The snapshot's meta record, or None when the snapshot is
+        truncated/corrupt (a crash mid-write must not kill the reader)."""
+        ocp = self._ocp
+        try:
+            return self._mgr.restore(
+                step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+            )["meta"]
+        except Exception:
+            return None
+
+    def _delete_quietly(self, step: int) -> None:
+        """Remove a snapshot we have decided is unusable. Deletion (not mere
+        skipping) matters: orbax refuses saves at steps at or below the
+        latest existing one, so a corrupt high-step snapshot would otherwise
+        block every post-resume save."""
+        try:
+            self._mgr.delete(step)
+        except Exception:
+            pass  # already half-gone; restore() skips it either way
 
     def save(self, stage: int, iteration: int, state: Any,
              stage0_mask=None, stage0_pattern=None) -> None:
+        """Snapshot the carry at a block boundary. The write is atomic at
+        the snapshot granularity: orbax stages the step into a tmp directory
+        and commits it with a single rename, so a crash mid-save leaves
+        either no step-dir or a complete one — and `restore` deletes any
+        half-committed leftovers and falls back to the previous snapshot."""
         ocp = self._ocp
         step = int(iteration)
         payload = {"state": state}
@@ -102,48 +158,82 @@ class CarryCheckpointer:
 
     def restore(self, state_template: Any, stage0_template=None
                 ) -> Optional[CarryCheckpoint]:
-        """Newest snapshot whose fingerprint matches this run's, arrays
-        placed like the (concrete) templates.
+        """Newest *readable* snapshot whose fingerprint matches this run's,
+        arrays placed like the (concrete) templates.
 
-        Mismatching snapshots are skipped (with a warning), not merely
-        rejected at the latest step: a stale run's high-step snapshot in the
-        same directory must not shadow this run's own valid ones."""
+        Two classes of snapshot are passed over, falling back to the next
+        older one instead of crashing mid-resume:
+
+        - fingerprint mismatch (skipped with a warning) — a stale run's
+          high-step snapshot in the same directory must not shadow this
+          run's own valid ones;
+        - truncated/corrupt payload (a crash or ENOSPC mid-save) — the
+          snapshot is deleted (it would block future saves at lower steps)
+          and the previous good one is restored instead."""
         ocp = self._ocp
-        steps = sorted(self._mgr.all_steps(), reverse=True)
-        meta = latest = None
-        for step in steps:
-            m = self._mgr.restore(
-                step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
-            )["meta"]
-            if self.fingerprint is not None and m.get("fingerprint") != self.fingerprint:
-                import warnings
+        import warnings
 
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            meta = self._read_meta(step)
+            if meta is None:
+                warnings.warn(
+                    f"carry snapshot {step} in {self.directory} is "
+                    "truncated/corrupt (unreadable meta); deleting it and "
+                    "falling back to the previous snapshot")
+                self._delete_quietly(step)
+                continue
+            if (self.fingerprint is not None
+                    and meta.get("fingerprint") != self.fingerprint):
                 warnings.warn(
                     f"carry snapshot {step} in {self.directory} has "
-                    f"fingerprint {m.get('fingerprint')!r} != this run's "
+                    f"fingerprint {meta.get('fingerprint')!r} != this run's "
                     f"{self.fingerprint!r}; skipping it")
                 continue
-            meta, latest = m, step
-            break
-        if latest is None:
-            return None
-        payload_t = {"state": state_template}
-        if meta["stage"] == 1:
-            if stage0_template is None:
-                raise ValueError("stage-1 checkpoint needs a stage-0 template")
-            payload_t["stage0"] = {
-                "mask": stage0_template[0], "pattern": stage0_template[1]}
-        restored = self._mgr.restore(
-            latest, args=ocp.args.Composite(carry=ocp.args.StandardRestore(payload_t))
-        )["carry"]
-        s0 = restored.get("stage0")
-        return CarryCheckpoint(
-            stage=int(meta["stage"]),
-            iteration=int(meta["iteration"]),
-            state=restored["state"],
-            stage0_mask=None if s0 is None else s0["mask"],
-            stage0_pattern=None if s0 is None else s0["pattern"],
-        )
+            payload_t = {"state": state_template}
+            if meta["stage"] == 1:
+                if stage0_template is None:
+                    raise ValueError(
+                        "stage-1 checkpoint needs a stage-0 template")
+                payload_t["stage0"] = {
+                    "mask": stage0_template[0], "pattern": stage0_template[1]}
+            try:
+                restored = self._mgr.restore(
+                    step, args=ocp.args.Composite(
+                        carry=ocp.args.StandardRestore(payload_t))
+                )["carry"]
+            except Exception as e:
+                warnings.warn(
+                    f"carry snapshot {step} in {self.directory} failed to "
+                    f"restore ({type(e).__name__}: {e}); deleting it and "
+                    "falling back to the previous snapshot")
+                self._delete_quietly(step)
+                continue
+            s0 = restored.get("stage0")
+            return CarryCheckpoint(
+                stage=int(meta["stage"]),
+                iteration=int(meta["iteration"]),
+                state=restored["state"],
+                stage0_mask=None if s0 is None else s0["mask"],
+                stage0_pattern=None if s0 is None else s0["pattern"],
+            )
+        return None
+
+    def latest_step_info(self) -> Optional[CarryCheckpoint]:
+        """Meta of the newest matching snapshot as a `CarryCheckpoint` with
+        `state`/arrays set to None — a host-only peek (no array restore) for
+        resume accounting ("did this attempt start from a checkpoint, and
+        how far along?"). Returns None when nothing restorable exists."""
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            meta = self._read_meta(step)
+            if meta is None:
+                continue
+            if (self.fingerprint is not None
+                    and meta.get("fingerprint") != self.fingerprint):
+                continue
+            return CarryCheckpoint(
+                stage=int(meta["stage"]), iteration=int(meta["iteration"]),
+                state=None, stage0_mask=None, stage0_pattern=None)
+        return None
 
     def clear(self) -> None:
         for step in list(self._mgr.all_steps()):

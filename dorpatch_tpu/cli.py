@@ -9,7 +9,7 @@ from __future__ import annotations
 import argparse
 
 from dorpatch_tpu.config import (AttackConfig, DefenseConfig,
-                                 ExperimentConfig, ServeConfig)
+                                 ExperimentConfig, FarmConfig, ServeConfig)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -157,6 +157,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-deadline-ms", type=float, default=2000.0,
                    help="default per-request latency budget; the batcher "
                         "flushes a partial batch once half of it is spent")
+    # farm (`python -m dorpatch_tpu.farm` shares these defaults; setting
+    # them here persists them into the config record a spec's `base` carries)
+    p.add_argument("--farm-lease-ttl", type=float, default=60.0,
+                   help="attack-sweep farm: heartbeat staleness (seconds) "
+                        "after which a worker's leased jobs are reclaimable "
+                        "by survivors; must exceed both the worker "
+                        "heartbeat interval and the longest gap between "
+                        "attack-block boundaries (lease renewal points)")
+    p.add_argument("--farm-max-attempts", type=int, default=3,
+                   help="attack-sweep farm: per-job attempt cap across "
+                        "transient retries and crash reclaims")
+    p.add_argument("--farm-backoff-base", type=float, default=2.0,
+                   help="attack-sweep farm: transient retry delay base "
+                        "(base * 2^(attempt-1), capped, plus deterministic "
+                        "per-job jitter)")
+    p.add_argument("--chaos", default="",
+                   help="attack-sweep farm fault injection (smoke/recovery "
+                        "testing): comma-joined list of crash_block, "
+                        "ckpt_raise, wedge_heartbeat, enospc_events")
     p.add_argument("--remat-policy", default="full",
                    choices=["full", "conv", "dots"],
                    help="what an active remat recomputes: full = the whole "
@@ -226,6 +245,10 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
                           max_batch=args.serve_max_batch,
                           max_queue_depth=args.serve_queue_depth,
                           deadline_ms=args.serve_deadline_ms),
+        farm=FarmConfig(lease_ttl=args.farm_lease_ttl,
+                        max_attempts=args.farm_max_attempts,
+                        backoff_base=args.farm_backoff_base,
+                        chaos=args.chaos),
     )
 
 

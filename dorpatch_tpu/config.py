@@ -183,6 +183,36 @@ class ServeConfig:
     warmup: bool = True             # compile every bucket's programs at start
 
 
+@dataclasses.dataclass(frozen=True)
+class FarmConfig:
+    """Attack-sweep farm knobs (`dorpatch_tpu/farm/`): how workers lease,
+    retry, and (in chaos mode) sabotage jobs over a shared farm directory.
+
+    `lease_ttl` is the reclaim latency after a worker dies: a lease is
+    fresh while the owner's heartbeat file advanced within the TTL, so it
+    must comfortably exceed `heartbeat_interval` AND the longest gap
+    between block boundaries (lease renewal points) — a slow compile inside
+    one block otherwise reads as a dead worker."""
+
+    lease_ttl: float = 60.0         # heartbeat staleness after which a
+                                    # worker's jobs are reclaimable
+    heartbeat_interval: float = 1.0  # worker liveness beat cadence; beats
+                                    # are the lease-expiry clock, so this
+                                    # is deliberately faster than the
+                                    # pipeline's telemetry default
+    max_attempts: int = 3           # per-job cap across transient retries
+                                    # and crash reclaims
+    backoff_base: float = 2.0       # transient retry delay: base * 2^(n-1)
+    backoff_cap: float = 300.0      # ... clipped here ...
+    backoff_jitter: float = 0.25    # ... times (1 + jitter * u), u drawn
+                                    # deterministically from the job id
+    poll_interval: float = 1.0      # idle worker re-scan cadence
+    chaos: str = ""                 # comma-joined fault injections for the
+                                    # smoke/recovery tests ("" = off):
+                                    # crash_block, ckpt_raise,
+                                    # wedge_heartbeat, enospc_events
+
+
 def config_to_dict(cfg: "ExperimentConfig") -> dict:
     """JSON-safe nested dict of the full experiment config (reproducibility
     record written beside summary.json by the pipelines)."""
@@ -209,9 +239,10 @@ def config_from_dict(d: dict) -> "ExperimentConfig":
     attack = build(AttackConfig, d.pop("attack", {}))
     defense = build(DefenseConfig, d.pop("defense", {}))
     serve = build(ServeConfig, d.pop("serve", {}))
+    farm = build(FarmConfig, d.pop("farm", {}))
     cfg = build(ExperimentConfig, d)
     return dataclasses.replace(cfg, attack=attack, defense=defense,
-                               serve=serve)
+                               serve=serve, farm=farm)
 
 
 def resolved_data_source(cfg: "ExperimentConfig") -> str:
@@ -289,6 +320,7 @@ class ExperimentConfig:
     attack: AttackConfig = dataclasses.field(default_factory=AttackConfig)
     defense: DefenseConfig = dataclasses.field(default_factory=DefenseConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    farm: FarmConfig = dataclasses.field(default_factory=FarmConfig)
 
     @property
     def num_classes(self) -> int:

@@ -1,0 +1,24 @@
+"""Attack-sweep farm: a standing, fault-tolerant red-teaming service.
+
+The paper's experiment grid (model family x patch budget x n_patch x dual
+occlusion) is embarrassingly parallel, but one-process-per-invocation runs
+lose the whole grid to a single crash. The farm turns a grid spec into a
+file-backed job queue (`queue.py`) that N worker processes (`worker.py`)
+drain cooperatively: atomic lease files with heartbeat-driven expiry make a
+SIGKILL'd or wedged worker's jobs reclaimable by survivors with no
+coordinator; per-job carry checkpoints make a reclaimed job *resume* rather
+than restart; a typed failure taxonomy retries transient errors with
+backoff and quarantines deterministic ones with their traceback. `chaos.py`
+injects each failure mode deterministically so every recovery path is
+provable, and `report.py` aggregates the fleet's accounting.
+
+CLI: ``python -m dorpatch_tpu.farm submit|work|status|report``.
+
+Import discipline: this module and `queue`/`report`/`chaos` stay host-only
+cheap; the model/compile stack loads only inside a worker actually running
+a job (`worker.default_runner`).
+"""
+
+from dorpatch_tpu.farm.queue import JobQueue, expand_grid, retry_delay  # noqa: F401
+
+__all__ = ["JobQueue", "expand_grid", "retry_delay"]

@@ -1,0 +1,207 @@
+"""Fleet-level aggregation across a farm directory's per-job telemetry.
+
+Joins every job's `job.json` (state machine, attempts, failures) with its
+results dir's `run.json` manifest (attempt chain), `events.jsonl` (block
+timing), and `rows.jsonl` (the actual sweep results) into one summary dict,
+rendered by `observe.report` (a farm dir handed to the report CLI is
+auto-detected via `farm.json`) and `python -m dorpatch_tpu.farm report`.
+
+Wasted-vs-useful accounting: each `block` event carries its (stage, step)
+coordinate. A coordinate executed once is useful work; re-executions of a
+coordinate already seen for that job are the work a crash/retry actually
+repeated. Crash-resume from a block checkpoint re-runs at most the partial
+block after the last snapshot, so its wasted time is near zero; a
+from-scratch retry re-runs everything, all of it counted wasted — the
+metric measures exactly what checkpointing buys.
+
+Host-only: reads files, never touches a jax backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from dorpatch_tpu.checkpoint import load_json
+from dorpatch_tpu.farm.queue import FARM_NAME, JobQueue
+
+ROW_KEYS = ("patch_budget", "density", "structured",
+            "robust_accuracy", "certified_asr_pc")
+
+
+def is_farm_dir(path: str) -> bool:
+    return os.path.exists(os.path.join(path, FARM_NAME))
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    out: List[dict] = []
+    try:
+        with open(path, errors="replace") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def _job_step_time(result_dir: str) -> Dict[str, float]:
+    """Useful vs re-executed block seconds for one job, across all its
+    attempts (events.jsonl is append-mode, so file order is chronological
+    across attempts)."""
+    useful = wasted = 0.0
+    reexecuted = 0
+    seen = set()
+    for record in _read_jsonl(os.path.join(result_dir, "events.jsonl")):
+        if record.get("kind") != "block":
+            continue
+        coord = (record.get("stage"), record.get("step"))
+        dur = float(record.get("dur_s", 0.0))
+        if coord in seen:
+            wasted += dur
+            reexecuted += 1
+        else:
+            seen.add(coord)
+            useful += dur
+    return {"useful_s": useful, "wasted_s": wasted,
+            "reexecuted_blocks": reexecuted}
+
+
+def summarize_fleet(farm_dir: str) -> Optional[dict]:
+    """The whole farm as one dict; None when `farm_dir` is not a farm."""
+    if not is_farm_dir(farm_dir):
+        return None
+    jq = JobQueue(farm_dir)
+    farm = load_json(os.path.join(farm_dir, FARM_NAME), {})
+    jobs: List[dict] = []
+    points: List[dict] = []
+    attempts_histogram: Dict[str, int] = {}
+    failures_by_kind: Dict[str, int] = {}
+    quarantined: List[dict] = []
+    retries = reclaims = 0
+    useful_s = wasted_s = 0.0
+    reexecuted_blocks = 0
+    for job_id in jq.job_ids():
+        job = jq.read_job(job_id)
+        if job is None:
+            jobs.append({"id": job_id, "state": "unreadable"})
+            continue
+        attempts = int(job.get("attempts", 0))
+        attempts_histogram[str(attempts)] = (
+            attempts_histogram.get(str(attempts), 0) + 1)
+        retries += max(0, attempts - 1)
+        reclaims += int(job.get("reclaims", 0))
+        for failure in job.get("failures", []):
+            kind = failure.get("kind", "unknown")
+            failures_by_kind[kind] = failures_by_kind.get(kind, 0) + 1
+        result_dir = os.path.join(jq.job_dir(job_id), "results")
+        manifest = load_json(os.path.join(result_dir, "run.json"))
+        attempt_chain = []
+        if manifest:
+            attempt_chain = ([manifest.get("run_id", "")]
+                             + list(manifest.get("previous_run_ids", [])))
+        step_time = _job_step_time(result_dir)
+        useful_s += step_time["useful_s"]
+        wasted_s += step_time["wasted_s"]
+        reexecuted_blocks += step_time["reexecuted_blocks"]
+        rows = _read_jsonl(os.path.join(result_dir, "rows.jsonl"))
+        for row in rows:
+            point = {"job": job_id}
+            point.update({k: row[k] for k in ROW_KEYS if k in row})
+            if "resumed_from_iteration" in row:
+                point["resumed_from_iteration"] = row["resumed_from_iteration"]
+            points.append(point)
+        if job.get("state") == "quarantined" and job.get("failures"):
+            last = job["failures"][-1]
+            quarantined.append({"id": job_id,
+                               "kind": last.get("kind", "unknown"),
+                               "error": last.get("error", "")})
+        jobs.append({
+            "id": job_id,
+            "state": ("failed_exhausted"
+                      if job.get("state") == "failed" and job.get("exhausted")
+                      else job.get("state", "")),
+            "attempts": attempts,
+            "reclaims": int(job.get("reclaims", 0)),
+            "run_ids": attempt_chain,
+            "rows": len(rows),
+            "resumed_points": sum(
+                1 for r in rows if "resumed_from_iteration" in r),
+            **step_time,
+        })
+    return {
+        "farm_dir": os.path.abspath(farm_dir),
+        "spec_jobs": int(farm.get("jobs", 0)),
+        "counts": jq.counts(),
+        "attempts_histogram": dict(sorted(attempts_histogram.items())),
+        "retries": retries,
+        "reclaims": reclaims,
+        "failures_by_kind": dict(sorted(failures_by_kind.items())),
+        "quarantined": quarantined,
+        "step_time": {"useful_s": round(useful_s, 3),
+                      "wasted_s": round(wasted_s, 3),
+                      "reexecuted_blocks": reexecuted_blocks},
+        "points": points,
+        "jobs": jobs,
+    }
+
+
+def format_fleet_report(s: dict) -> str:
+    """Human rendering of a `summarize_fleet()` dict, in the same visual
+    dialect as `observe.report.format_report`."""
+    lines: List[str] = []
+    add = lines.append
+    add("= DorPatch attack-sweep farm report =")
+    add(f"farm dir: {s['farm_dir']}")
+    c = s["counts"]
+    add("-- farm --")
+    add(f"  jobs: {c['total']} total — {c['done']} done, "
+        f"{c['quarantined']} quarantined, "
+        f"{c['failed_retryable']} retryable, "
+        f"{c['failed_exhausted']} exhausted, {c['pending']} pending, "
+        f"{c['leased'] + c['running']} in flight, "
+        f"{c['unreadable']} unreadable")
+    hist = ", ".join(f"{k}: {v}"
+                     for k, v in s["attempts_histogram"].items())
+    add(f"  attempts histogram: {hist or '(none)'}  "
+        f"(retries {s['retries']}, reclaims {s['reclaims']})")
+    if s["failures_by_kind"]:
+        add("  failures: " + ", ".join(
+            f"{k}: {v}" for k, v in s["failures_by_kind"].items()))
+    st = s["step_time"]
+    total = st["useful_s"] + st["wasted_s"]
+    pct = (100.0 * st["wasted_s"] / total) if total else 0.0
+    add(f"  step time: {st['useful_s']:.3f}s useful, "
+        f"{st['wasted_s']:.3f}s re-executed ({pct:.1f}% waste, "
+        f"{st['reexecuted_blocks']} re-run block(s))")
+    for q in s["quarantined"]:
+        add(f"  quarantined {q['id']}: [{q['kind']}] {q['error'][:90]}")
+    add("-- jobs --")
+    for j in s["jobs"]:
+        if j.get("state") == "unreadable":
+            add(f"  {j['id']}: UNREADABLE job.json")
+            continue
+        resumed = (f", {j['resumed_points']} resumed"
+                   if j.get("resumed_points") else "")
+        add(f"  {j['id']:<28} {j['state']:<12} "
+            f"attempts {j['attempts']}"
+            f" ({len(j.get('run_ids', []))} run id(s))"
+            f", rows {j.get('rows', 0)}{resumed}")
+    if s["points"]:
+        add("-- robust accuracy --")
+        for p in s["points"]:
+            ra = p.get("robust_accuracy")
+            ca = p.get("certified_asr_pc")
+            resumed = (f"  [resumed @ {p['resumed_from_iteration']}]"
+                       if "resumed_from_iteration" in p else "")
+            add(f"  {p['job']:<28} budget {p.get('patch_budget', '?')} "
+                f"density {p.get('density', '?')} "
+                f"structured {p.get('structured', '?')}: "
+                f"robust acc {ra}%, certified ASR {ca}%{resumed}")
+    return "\n".join(lines)

@@ -1,0 +1,321 @@
+"""Farm worker: claim -> run -> commit, with typed failure handling.
+
+A worker is a plain process over the shared farm directory. Per claimed job
+it wires the full observability/recovery stack the pipelines already use —
+`run.json` manifest (attempt chaining via `previous_run_ids`), per-job
+`events.jsonl`, carry checkpoints at block boundaries — then runs the job's
+grid slice through `sweep.run_sweep`. The farm layer launches only the
+already-registered jit programs; it adds no entry points of its own, so the
+zero-recompile and audit guarantees carry over untouched.
+
+Failure taxonomy (`classify_failure`):
+
+- *transient* (OOM, IO/ENOSPC, preemption, unclassified runtime errors) —
+  the job returns to `failed` with exponential backoff + deterministic
+  jitter and is retried until `max_attempts`; its checkpoints survive, so a
+  retry resumes rather than restarts.
+- *deterministic* (trace/shape errors, NaN loss from the sanitizer,
+  recompile-budget violations) — retrying would fail identically: the job
+  is quarantined immediately with the traceback in `job.json`, so one bad
+  grid point never poisons the queue or burns the fleet's time.
+
+Lease discipline: the lease is renewed at every attack-block boundary, and
+every commit re-checks ownership — a worker that lost its lease (wedged
+heartbeat, reclaimed job) abandons silently; the reclaimer owns the state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import traceback
+from typing import Callable, Dict, Optional, Tuple
+
+from dorpatch_tpu import observe
+from dorpatch_tpu.config import ExperimentConfig, config_from_dict
+from dorpatch_tpu.farm import queue as farm_queue
+from dorpatch_tpu.farm.chaos import Chaos, SimulatedPreemption, parse_faults
+
+
+class LeaseLost(RuntimeError):
+    """This worker's lease was reclaimed mid-run; the job is no longer ours
+    to execute or to commit state for."""
+
+
+def classify_failure(exc: BaseException) -> Tuple[str, bool]:
+    """(kind, transient). Unclassified errors count as transient: wrongly
+    retrying a deterministic bug costs `max_attempts - 1` wasted runs before
+    the job parks as exhausted, while wrongly quarantining a transient blip
+    silently loses a finishable job — the cheaper mistake wins."""
+    if isinstance(exc, SimulatedPreemption):
+        return "preemption", True
+    if isinstance(exc, MemoryError):
+        return "oom", True
+    if isinstance(exc, OSError):
+        return "io", True
+    name = type(exc).__name__
+    if name == "RecompileBudgetExceeded":
+        return "recompile", False
+    if name == "XlaRuntimeError":
+        msg = str(exc).lower()
+        if "resource exhausted" in msg or "out of memory" in msg:
+            return "oom", True
+        return "xla", True
+    if isinstance(exc, FloatingPointError):
+        return "nan", False  # jax_debug_nans sanitizer: NaN at the source
+    if isinstance(exc, (TypeError, ValueError, KeyError, AttributeError,
+                        IndexError)):
+        return "trace", False  # shape/trace/config programming errors
+    return "unknown", True
+
+
+def apply_overrides(cfg: ExperimentConfig, params: Dict) -> ExperimentConfig:
+    """Dotted job-axis overrides onto a config: ``"attack.patch_budget"``
+    reaches into the nested dataclass, bare keys hit `ExperimentConfig`
+    itself. Unknown fields raise (dataclasses.replace) -> deterministic
+    quarantine, which is exactly right for a typo'd spec axis."""
+    for key in sorted(params):
+        value = params[key]
+        if isinstance(value, list):
+            value = tuple(value)
+        if "." in key:
+            head, field = key.split(".", 1)
+            if "." in field:
+                raise ValueError(f"axis {key!r}: at most one dot")
+            sub = dataclasses.replace(getattr(cfg, head), **{field: value})
+            cfg = dataclasses.replace(cfg, **{head: sub})
+        else:
+            cfg = dataclasses.replace(cfg, **{key: value})
+    return cfg
+
+
+def job_config(job: Dict) -> ExperimentConfig:
+    """The job's resolved config: partial base dict (defaults fill the
+    rest) + this job's grid-point overrides."""
+    return apply_overrides(config_from_dict(dict(job.get("base", {}))),
+                           job.get("params", {}))
+
+
+@dataclasses.dataclass
+class JobContext:
+    """Everything a runner needs beyond the job dict — kept explicit so
+    tests can substitute a stub runner with no model/compile cost."""
+
+    result_dir: str
+    checkpoint_root: str
+    chaos: Optional[Chaos]
+    on_block_end: Optional[Callable[[int, int, dict], None]]
+    checkpointer_factory: Optional[Callable[[int, Dict], object]]
+
+
+def default_runner(job: Dict, ctx: JobContext) -> Dict:
+    """Run the job's grid slice via `sweep.run_sweep` with the crash-resume
+    wiring attached (incremental rows, per-point carry checkpoints, the
+    lease/chaos block hook)."""
+    from dorpatch_tpu.sweep import run_sweep  # lazy: pulls the model stack
+
+    cfg = job_config(job)
+    sweep_kw = dict(job.get("sweep", {}))
+    rows = run_sweep(
+        cfg,
+        patch_budgets=tuple(sweep_kw.get("patch_budgets",
+                                         (cfg.attack.patch_budget,))),
+        densities=tuple(sweep_kw.get("densities", (cfg.attack.density,))),
+        structureds=tuple(sweep_kw.get("structureds",
+                                       (cfg.attack.structured,))),
+        defense_ratio=float(sweep_kw.get("defense_ratio", 0.06)),
+        verbose=False,
+        result_dir=ctx.result_dir,
+        checkpointer_factory=ctx.checkpointer_factory,
+        on_block_end=ctx.on_block_end,
+    )
+    return {
+        "rows": len(rows),
+        "resumed_points": sum(
+            1 for r in rows if "resumed_from_iteration" in r),
+    }
+
+
+class FarmWorker:
+    """One worker process's claim-and-run loop over a farm directory."""
+
+    def __init__(self, farm_dir: str, worker_id: Optional[str] = None,
+                 lease_ttl: float = 60.0,
+                 backoff_base: float = 2.0, backoff_cap: float = 300.0,
+                 backoff_jitter: float = 0.25, poll_interval: float = 1.0,
+                 heartbeat_interval: float = 1.0, chaos: str = "",
+                 crash_mode: str = "kill",
+                 runner: Optional[Callable[[Dict, JobContext], Dict]] = None,
+                 clock=time.time, sleep=time.sleep):
+        self.queue = farm_queue.JobQueue(farm_dir, clock=clock)
+        self.worker_id = worker_id or f"w{os.getpid()}"
+        self.lease_ttl = float(lease_ttl)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.backoff_jitter = float(backoff_jitter)
+        self.poll_interval = float(poll_interval)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.chaos_faults = parse_faults(chaos) if chaos else ()
+        self.crash_mode = crash_mode
+        self.runner = runner if runner is not None else default_runner
+        self._clock = clock
+        self._sleep = sleep
+        self.worker_dir = os.path.join(self.queue.farm_dir, "workers",
+                                       self.worker_id)
+        self.heartbeat_path = os.path.join(self.worker_dir,
+                                           observe.heartbeat_filename(0))
+        self._phase = "idle"
+        self._heartbeat: Optional[observe.Heartbeat] = None
+
+    # ---------------- the drain loop ----------------
+
+    def run(self, max_jobs: Optional[int] = None) -> Dict:
+        """Claim and run jobs until the queue is drained (or `max_jobs`
+        handled). Polls while other workers hold live leases — their jobs
+        become claimable here the moment their heartbeats go stale."""
+        os.makedirs(self.worker_dir, exist_ok=True)
+        summary = {"worker": self.worker_id, "done": 0, "failed": 0,
+                   "quarantined": 0, "abandoned": 0}
+        heartbeat = observe.Heartbeat(
+            self.heartbeat_path, get_phase=lambda: self._phase,
+            interval=self.heartbeat_interval, clock=self._clock)
+        with heartbeat:
+            self._heartbeat = heartbeat
+            try:
+                while True:
+                    if max_jobs is not None and sum(
+                            summary[k] for k in
+                            ("done", "failed", "quarantined", "abandoned")
+                    ) >= max_jobs:
+                        break
+                    job = self.queue.claim(self.worker_id, self.lease_ttl,
+                                           self.heartbeat_path)
+                    if job is None:
+                        counts = self.queue.counts()
+                        if self.queue.drained(counts):
+                            break
+                        self._sleep(self.poll_interval)
+                        continue
+                    outcome = self.run_one(job)
+                    summary[outcome] += 1
+                    if (outcome == "abandoned" and self.chaos_faults
+                            and "wedge_heartbeat" in self.chaos_faults):
+                        # our beats stopped: every lease we'd take is born
+                        # stale — stop claiming instead of thrashing jobs
+                        # back and forth with the healthy workers
+                        summary["wedged"] = True
+                        break
+            finally:
+                self._heartbeat = None
+        summary["counts"] = self.queue.counts()
+        return summary
+
+    # ---------------- one job ----------------
+
+    def run_one(self, job: Dict) -> str:
+        """Execute one claimed job to a single outcome: ``done``,
+        ``failed`` (transient, retryable), ``quarantined`` (deterministic),
+        or ``abandoned`` (lease lost — the reclaimer owns the state)."""
+        jq = self.queue
+        job_id = job["id"]
+        job_dir = jq.job_dir(job_id)
+        result_dir = os.path.join(job_dir, "results")
+        checkpoint_root = os.path.join(job_dir, "checkpoints")
+        chaos = None
+        if self.chaos_faults:
+            chaos = Chaos(self.chaos_faults, job_id, job_dir,
+                          crash_mode=self.crash_mode).bind(self._heartbeat)
+        jq.mark_running(job, self.worker_id)
+        self._phase = f"job/{job_id}"
+        run_id = observe.new_run_id()
+        try:
+            os.makedirs(result_dir, exist_ok=True)
+            cfg = job_config(job)
+            observe.write_run_manifest(
+                result_dir, cfg, run_id=run_id,
+                extra={"farm": {"job": job_id, "worker": self.worker_id,
+                                "attempt": job["attempts"]}})
+
+            def on_block(stage: int, iteration: int,
+                         info: Optional[dict] = None) -> None:
+                if chaos is not None:
+                    chaos.on_block(stage, iteration, info)
+                if not jq.renew_lease(job_id, self.worker_id,
+                                      self.lease_ttl):
+                    raise LeaseLost(
+                        f"lease on {job_id} reclaimed mid-run")
+
+            def checkpointer_factory(point: int, point_params: Dict):
+                from dorpatch_tpu.checkpoint import CarryCheckpointer
+
+                # fingerprint is attempt-INdependent: a retry must restore
+                # the previous attempt's snapshots, that is the whole point
+                ck = CarryCheckpointer(
+                    os.path.join(checkpoint_root, f"carry_{point}"),
+                    fingerprint={"job": job_id, "point": int(point),
+                                 **{k: float(v)
+                                    for k, v in point_params.items()}})
+                return (chaos.wrap_checkpointer(ck) if chaos is not None
+                        else ck)
+
+            ctx = JobContext(result_dir=result_dir,
+                             checkpoint_root=checkpoint_root, chaos=chaos,
+                             on_block_end=on_block,
+                             checkpointer_factory=checkpointer_factory)
+            event_log = observe.EventLog(
+                os.path.join(result_dir, observe.events_filename(0)),
+                run_id=run_id)
+            if chaos is not None:
+                chaos.wrap_event_log(event_log)
+            with event_log, observe.active(event_log):
+                with observe.span("farm.job", job=job_id,
+                                  attempt=job["attempts"]):
+                    result = self.runner(job, ctx)
+        except LeaseLost:
+            observe.log(f"worker {self.worker_id}: abandoned {job_id} "
+                        "(lease reclaimed)")
+            return "abandoned"
+        except Exception as exc:
+            return self._commit_failure(job, exc)
+        finally:
+            self._phase = "idle"
+        if not jq.owns_lease(job_id, self.worker_id):
+            observe.log(f"worker {self.worker_id}: finished {job_id} but "
+                        "the lease moved on; abandoning the commit")
+            return "abandoned"
+        jq.mark_done(job, result if isinstance(result, dict) else {})
+        jq.release_lease(job_id, self.worker_id)
+        observe.log(f"worker {self.worker_id}: {job_id} done "
+                    f"(attempt {job['attempts']})")
+        return "done"
+
+    def _commit_failure(self, job: Dict, exc: Exception) -> str:
+        jq = self.queue
+        job_id = job["id"]
+        kind, transient = classify_failure(exc)
+        if not jq.owns_lease(job_id, self.worker_id):
+            return "abandoned"
+        failure = {
+            "attempt": int(job["attempts"]),
+            "kind": kind,
+            "transient": transient,
+            "error": f"{type(exc).__name__}: {exc}",
+            "traceback": traceback.format_exc(),
+            "worker": self.worker_id,
+            "ts": round(self._clock(), 3),
+        }
+        if not transient:
+            jq.mark_quarantined(job, failure)
+            outcome = "quarantined"
+        else:
+            delay = farm_queue.retry_delay(
+                job_id, int(job["attempts"]), base=self.backoff_base,
+                cap=self.backoff_cap, jitter=self.backoff_jitter)
+            jq.mark_failed(job, failure,
+                           next_retry_ts=self._clock() + delay)
+            outcome = "failed"
+        jq.release_lease(job_id, self.worker_id)
+        observe.log(f"worker {self.worker_id}: {job_id} {outcome} "
+                    f"({kind}: {exc})")
+        return outcome

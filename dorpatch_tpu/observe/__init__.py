@@ -55,6 +55,7 @@ from dorpatch_tpu.observe.heartbeat import (  # noqa: F401
     Watchdog,
     heartbeat_filename,
     heartbeat_gaps,
+    last_beat_ts,
     read_heartbeats,
     summarize_heartbeats,
 )
@@ -86,6 +87,7 @@ __all__ = [
     "heartbeat_filename",
     "heartbeat_gaps",
     "jax_environment",
+    "last_beat_ts",
     "log",
     "nearest_rank_percentile",
     "new_run_id",

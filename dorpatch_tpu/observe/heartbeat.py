@@ -54,6 +54,7 @@ class Heartbeat:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._wedged = False
         self._fh: Optional[IO[str]] = None
         if path:
             try:
@@ -98,13 +99,27 @@ class Heartbeat:
             self._thread.start()
         return self
 
+    def wedge(self) -> None:
+        """Stop beating WITHOUT the final `exit` beat — the file freezes at
+        the last ordinary beat, exactly what a process stuck inside a device
+        call (or SIGSTOP'd) looks like from the outside. Chaos/test hook:
+        the farm's lease expiry is driven by beat staleness, so wedging a
+        live worker is how the reclaim path is exercised without killing
+        the process that injects the fault."""
+        self._wedged = True  # close() must not append the exit beat either
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval + 1.0)
+            self._thread = None
+
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2 * self.interval + 1.0)
             self._thread = None
         if self._fh is not None:
-            self.beat(phase="exit")  # clean shutdown is visible post-mortem
+            if not self._wedged:
+                self.beat(phase="exit")  # clean shutdown visible post-mortem
             self._fh.close()
             self._fh = None
 
@@ -125,7 +140,11 @@ def read_heartbeats(result_dir: str) -> Dict[str, List[dict]]:
     for path in sorted(glob.glob(os.path.join(result_dir, "heartbeat_*.jsonl"))):
         beats = []
         try:
-            with open(path) as fh:
+            # errors="replace": a beat truncated mid-multibyte-char (SIGKILL
+            # between write syscalls) must not raise UnicodeDecodeError; the
+            # mangled line then fails json parsing and is skipped like any
+            # other partial line.
+            with open(path, errors="replace") as fh:
                 for line in fh:
                     line = line.strip()
                     if not line:
@@ -138,6 +157,34 @@ def read_heartbeats(result_dir: str) -> Dict[str, List[dict]]:
             continue
         out[os.path.basename(path)] = beats
     return out
+
+
+def last_beat_ts(path: str) -> Optional[float]:
+    """Timestamp of the newest parseable beat in ONE heartbeat file, or None
+    when the file is missing/empty/unreadable.
+
+    This is the farm's lease-liveness primitive: a worker's lease is fresh
+    exactly while its heartbeat file keeps advancing, so the reader must be
+    cheap (tail read, not a full parse) and must tolerate a final line
+    truncated by the very crash it is there to detect."""
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - 8192))
+            tail = fh.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+            return float(rec["ts"])
+        except (ValueError, KeyError, TypeError):
+            continue
+    return None
 
 
 def heartbeat_gaps(beats: List[dict]) -> List[float]:

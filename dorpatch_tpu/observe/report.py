@@ -470,6 +470,22 @@ def main(argv=None) -> int:
     if not os.path.isdir(args.result_dir):
         print(f"not a directory: {args.result_dir}")
         return 2
+    # a farm dir (marked by farm.json) gets the fleet-level report; the
+    # import is lazy and farm.report is host-only, same contract as here
+    farm_marker = os.path.join(args.result_dir, "farm.json")
+    if os.path.exists(farm_marker):
+        from dorpatch_tpu.farm.report import (format_fleet_report,
+                                              summarize_fleet)
+
+        fleet = summarize_fleet(args.result_dir)
+        try:
+            if args.json:
+                print(json.dumps(fleet, indent=1, default=float))
+            else:
+                print(format_fleet_report(fleet))
+        except BrokenPipeError:
+            return 0
+        return 0
     s = summarize(args.result_dir, stall_factor=args.stall_factor)
     if not s["manifest"] and not s["attempts"] and not s["heartbeats"] \
             and not s["metrics_records"]["total"]:

@@ -24,8 +24,9 @@ import argparse
 import dataclasses
 import itertools
 import json
+import os
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +40,50 @@ from dorpatch_tpu.data import dataset_batches
 from dorpatch_tpu.defense import build_defenses
 from dorpatch_tpu.models import get_model
 
+ROWS_NAME = "rows.jsonl"
+GRID_KEYS = ("patch_budget", "density", "structured")
+
+
+def row_key(patch_budget: float, density: float, structured: float) -> Tuple:
+    """Hashable identity of one grid point, stable across a JSON round-trip
+    (recorded rows come back from `rows.jsonl` with json's float formatting,
+    so the in-memory key must go through the same representation)."""
+    return tuple(json.loads(json.dumps(
+        [float(patch_budget), float(density), float(structured)])))
+
+
+def load_recorded_rows(result_dir: str) -> Dict[Tuple, Dict]:
+    """{grid-point key: row} already recorded in `result_dir`'s rows.jsonl.
+
+    Tolerant of a truncated final line — the file is appended row-by-row and
+    the previous sweep may have been killed mid-write; a partial row is
+    simply not recorded, so that point re-runs."""
+    out: Dict[Tuple, Dict] = {}
+    try:
+        with open(os.path.join(result_dir, ROWS_NAME), errors="replace") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if all(k in row for k in GRID_KEYS):
+                    out[row_key(*(row[k] for k in GRID_KEYS))] = row
+    except OSError:
+        pass
+    return out
+
+
+def append_row(result_dir: str, row: Dict) -> None:
+    """Flush one completed grid row to `rows.jsonl` (append-mode,
+    line-buffered — the same pattern as metrics.jsonl): a killed sweep keeps
+    every finished row and loses at most the one in flight."""
+    os.makedirs(result_dir, exist_ok=True)
+    with open(os.path.join(result_dir, ROWS_NAME), "a", buffering=1) as fh:
+        fh.write(json.dumps(row, default=float) + "\n")
+
 
 def run_sweep(
     cfg: ExperimentConfig,
@@ -47,12 +92,25 @@ def run_sweep(
     structureds: Sequence[float] = (1e-3,),
     defense_ratio: float = 0.06,
     verbose: bool = True,
+    result_dir: Optional[str] = None,
+    checkpointer_factory: Optional[Callable[[int, Dict], object]] = None,
+    on_block_end: Optional[Callable[[int, int, dict], None]] = None,
 ) -> List[Dict]:
     """Grid-attack one evaluation batch; one result row per grid point.
 
     Row: the point's hyperparameters, robust accuracy (victim still correct
     under the patch), certified-ASR at `defense_ratio`, mean patch L2, and
-    wall seconds."""
+    wall seconds.
+
+    With `result_dir`, each completed row is appended to `rows.jsonl` as it
+    finishes and the final patch artifacts are saved per point — and on
+    re-invocation over the same directory, already-recorded grid points are
+    skipped (their recorded rows are returned in place), so a killed sweep
+    resumes instead of restarting. `checkpointer_factory(point_index,
+    point_params)` (returning a `CarryCheckpointer` or None) additionally
+    checkpoints the attack carry at block boundaries, so even the
+    interrupted point resumes mid-stage; `on_block_end` is forwarded to
+    every point's `DorPatch` (the farm's lease-renewal/chaos hook)."""
     victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size,
                        gn_impl=cfg.gn_impl)
     data_source = resolved_data_source(cfg)
@@ -76,46 +134,87 @@ def run_sweep(
 
     rows: List[Dict] = []
     grid = list(itertools.product(patch_budgets, densities, structureds))
+    recorded = load_recorded_rows(result_dir) if result_dir else {}
+    if result_dir:
+        os.makedirs(result_dir, exist_ok=True)
     proto: Optional[DorPatch] = None
     for gi, (budget, density, structured) in enumerate(grid):
+        prior = recorded.get(row_key(budget, density, structured))
+        if prior is not None:
+            # already completed by an earlier (killed) invocation of this
+            # sweep: keep its recorded metrics, spend nothing re-attacking
+            rows.append(prior)
+            if verbose:
+                observe.log(json.dumps({"sweep_resume_skip": gi,
+                                        "patch_budget": budget,
+                                        "density": density,
+                                        "structured": structured}))
+            continue
         acfg = dataclasses.replace(
             cfg.attack, patch_budget=budget, density=density,
             structured=structured)
-        attack = DorPatch(victim.apply, victim.params, victim.num_classes, acfg)
+        attack = DorPatch(victim.apply, victim.params, victim.num_classes,
+                          acfg, on_block_end=on_block_end)
         if proto is None:
             proto = attack
         else:
             attack.adopt_compiled(proto)  # zero recompiles across the grid
-        timer = observe.StepTimer()
-        timer.start()
-        # same key for every grid point (the reference protocol: one process
-        # per point, same --seed) so row deltas isolate the hyperparameters
-        with observe.span("sweep.point", point=gi, patch_budget=budget,
-                          density=density, structured=structured):
-            res = attack.generate(x, key=jax.random.PRNGKey(cfg.seed))
-            jax.block_until_ready(res.adv_pattern)
-        seconds = timer.stop()
+        point_params = {"patch_budget": budget, "density": density,
+                        "structured": structured}
+        ck = (checkpointer_factory(gi, point_params)
+              if checkpointer_factory is not None else None)
+        resumed = ck.latest_step_info() if ck is not None else None
+        if ck is not None:
+            attack.checkpointer = ck
+        try:
+            timer = observe.StepTimer()
+            timer.start()
+            # same key for every grid point (the reference protocol: one
+            # process per point, same --seed) so row deltas isolate the
+            # hyperparameters
+            with observe.span("sweep.point", point=gi, patch_budget=budget,
+                              density=density, structured=structured):
+                res = attack.generate(x, key=jax.random.PRNGKey(cfg.seed))
+                jax.block_until_ready(res.adv_pattern)
+            seconds = timer.stop()
 
-        delta = losses.l2_project(res.adv_mask, res.adv_pattern, x, acfg.eps)
-        adv_x = x + delta
-        preds_adv = np.asarray(jnp.argmax(victim.apply(victim.params, adv_x), -1))
-        with observe.span("certify", point=gi, images=int(x.shape[0])):
-            recs = defense.robust_predict(
-                victim.params, adv_x, victim.num_classes)
-        defense.collect(recs)  # one metric definition (metrics.compute_metrics)
-        m = metrics.compute_metrics(
-            np.asarray(y_np), y_np, preds_adv, [defense.result])
-        row = {
-            "patch_budget": budget,
-            "density": density,
-            "structured": structured,
-            "robust_accuracy": m["robust_accuracy"],
-            "asr": round(100.0 - m["robust_accuracy"], 4),
-            "certified_asr_pc": m["certified_asr_pc"][0],
-            "mean_l2": float(jnp.sqrt(jnp.sum(delta**2, axis=(1, 2, 3))).mean()),
-            "images": int(x.shape[0]),
-            "seconds": round(seconds, 2),
-        }
+            delta = losses.l2_project(res.adv_mask, res.adv_pattern, x, acfg.eps)
+            adv_x = x + delta
+            preds_adv = np.asarray(jnp.argmax(victim.apply(victim.params, adv_x), -1))
+            with observe.span("certify", point=gi, images=int(x.shape[0])):
+                recs = defense.robust_predict(
+                    victim.params, adv_x, victim.num_classes)
+            defense.collect(recs)  # one metric definition (metrics.compute_metrics)
+            m = metrics.compute_metrics(
+                np.asarray(y_np), y_np, preds_adv, [defense.result])
+            row = {
+                "point": gi,
+                "patch_budget": budget,
+                "density": density,
+                "structured": structured,
+                "robust_accuracy": m["robust_accuracy"],
+                "asr": round(100.0 - m["robust_accuracy"], 4),
+                "certified_asr_pc": m["certified_asr_pc"][0],
+                "mean_l2": float(jnp.sqrt(jnp.sum(delta**2, axis=(1, 2, 3))).mean()),
+                "images": int(x.shape[0]),
+                "seconds": round(seconds, 2),
+            }
+            if resumed is not None:
+                # provable crash-recovery accounting: this attempt started
+                # from a carry snapshot, not from iteration zero
+                row["resumed_from_stage"] = resumed.stage
+                row["resumed_from_iteration"] = resumed.iteration
+            if result_dir:
+                np.save(os.path.join(result_dir, f"point_{gi:03d}_mask.npy"),
+                        np.asarray(res.adv_mask))
+                np.save(os.path.join(result_dir, f"point_{gi:03d}_pattern.npy"),
+                        np.asarray(res.adv_pattern))
+                append_row(result_dir, row)
+            if ck is not None:
+                ck.clear()  # row recorded: a stale carry must never leak
+        finally:
+            if ck is not None:
+                ck.close()
         rows.append(row)
         if verbose:
             observe.log(json.dumps(row))
@@ -146,6 +245,9 @@ def main(argv: Optional[Sequence[str]] = None):
     p.add_argument("--densities", type=float, nargs="+", default=[0.0, 1e-3])
     p.add_argument("--structureds", type=float, nargs="+", default=[1e-3])
     p.add_argument("--defense-ratio", type=float, default=0.06)
+    p.add_argument("--result-dir", default="",
+                   help="persist rows.jsonl + per-point patch artifacts here;"
+                        " re-running over the same dir skips finished rows")
     args = p.parse_args(argv)
 
     attack = AttackConfig(
@@ -164,7 +266,7 @@ def main(argv: Optional[Sequence[str]] = None):
     )
     t0 = time.time()
     rows = run_sweep(cfg, args.patch_budgets, args.densities, args.structureds,
-                     args.defense_ratio)
+                     args.defense_ratio, result_dir=args.result_dir or None)
     observe.log(json.dumps({"sweep_points": len(rows),
                             "total_seconds": round(time.time() - t0, 1)}))
     return rows

@@ -81,3 +81,15 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   | grep -q '"parity": true' \
   || { echo "certify-incr smoke: parity/forward-equivalents violation"; exit 1; }
 echo "certify incr smoke: OK"
+# Smoke: fault-tolerant attack-sweep farm — submit a 4-job grid, SIGKILL a
+# chaos worker mid-job after its carry snapshot lands, then drain with two
+# healthy workers: every job must finish, the killed job must show
+# attempts==2 / reclaims==1 and a checkpoint-resumed point whose final
+# artifacts are bit-identical to an uninterrupted control run, and the
+# fleet report must render the retry accounting (tools/farm_smoke.py exits
+# non-zero and lists the violations otherwise).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/farm_smoke.py \
+  | grep -q '"ok": true' \
+  || { echo "farm smoke: crash-resume violation"; exit 1; }
+echo "farm smoke: OK"

@@ -1,5 +1,8 @@
 """Carry checkpointing: orbax roundtrip and mid-stage crash recovery."""
 
+import glob
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -165,3 +168,79 @@ def test_fingerprint_purge_unblocks_new_runs_saves(tmp_path):
         got = ck.restore(state)
         assert got is not None
         assert (got.stage, got.iteration) == (0, 2)
+
+
+def _two_snapshots(tmp_path):
+    atk = _tiny_attack(_cfg())
+    x = jax.random.uniform(jax.random.PRNGKey(0), (1, 16, 16, 3))
+    state = atk._init_state(jax.random.PRNGKey(1), x,
+                            jnp.zeros((1,), jnp.int32), False, 10)
+    d = str(tmp_path / "ck")
+    with CarryCheckpointer(d) as ck:
+        ck.save(0, 2, state)
+        ck.save(0, 4, state)
+    return d, state
+
+
+def test_truncated_meta_falls_back_to_previous_snapshot(tmp_path):
+    """A crash/ENOSPC mid-save can leave the newest snapshot's meta record
+    truncated; restore must warn, delete it, and fall back to the previous
+    good snapshot instead of dying mid-resume."""
+    d, state = _two_snapshots(tmp_path)
+    meta_path = glob.glob(os.path.join(d, "4", "meta*", "*"))[0]
+    with open(meta_path, "w") as fh:
+        fh.write('{"stage": 0, "iter')  # truncated mid-write
+    with CarryCheckpointer(d) as ck:
+        with pytest.warns(UserWarning, match="truncated/corrupt"):
+            got = ck.restore(state)
+        assert got is not None and (got.stage, got.iteration) == (0, 2)
+        assert 4 not in ck._mgr.all_steps()  # deleted, not just skipped
+
+
+def test_corrupt_payload_falls_back_and_unblocks_saves(tmp_path):
+    """Readable meta but truncated array payload: the restore attempt fails,
+    the snapshot is deleted (a corrupt high step would block every later
+    save — orbax requires monotonic steps), and the previous one restores."""
+    d, state = _two_snapshots(tmp_path)
+    for path in glob.glob(os.path.join(d, "4", "carry", "**", "*"),
+                          recursive=True):
+        if os.path.isfile(path):
+            with open(path, "r+b") as fh:
+                fh.truncate(3)
+    with CarryCheckpointer(d) as ck:
+        with pytest.warns(UserWarning, match="falling back"):
+            got = ck.restore(state)
+        assert got is not None and (got.stage, got.iteration) == (0, 2)
+        # the corrupt step 4 is gone, so a new save at step 3 is accepted
+        ck.save(0, 3, state)
+        assert sorted(ck._mgr.all_steps()) == [2, 3]
+    with CarryCheckpointer(d) as ck:
+        got = ck.restore(state)
+        assert (got.stage, got.iteration) == (0, 3)
+
+
+def test_restore_all_snapshots_corrupt_returns_none(tmp_path):
+    d, state = _two_snapshots(tmp_path)
+    for step in ("2", "4"):
+        meta_path = glob.glob(os.path.join(d, step, "meta*", "*"))[0]
+        with open(meta_path, "w") as fh:
+            fh.write("not json")
+    with CarryCheckpointer(d) as ck:
+        with pytest.warns(UserWarning):
+            assert ck.restore(state) is None
+
+
+def test_atomic_write_json_and_tolerant_load(tmp_path):
+    from dorpatch_tpu.checkpoint import atomic_write_json, load_json
+
+    path = str(tmp_path / "state.json")
+    atomic_write_json(path, {"a": 1})
+    assert load_json(path) == {"a": 1}
+    assert not glob.glob(path + ".tmp.*")  # no stray tmp after commit
+    atomic_write_json(path, {"a": 2})
+    assert load_json(path) == {"a": 2}
+    with open(path, "w") as fh:
+        fh.write('{"a": ')  # torn write
+    assert load_json(path) is None
+    assert load_json(path, default={}) == {}
+    assert load_json(str(tmp_path / "missing.json"), default=7) == 7

@@ -1,0 +1,614 @@
+"""Attack-sweep farm: queue/lease protocol, failure taxonomy, chaos, report.
+
+Fast tests drive the farm with stub runners (no model build, no compile);
+the slow test proves crash-resume parity end-to-end on the real attack.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from dorpatch_tpu.config import AttackConfig, ExperimentConfig
+from dorpatch_tpu.farm.chaos import (
+    Chaos, SimulatedPreemption, fault_seed, parse_faults)
+from dorpatch_tpu.farm.queue import (
+    JobQueue, expand_grid, job_slug, retry_delay)
+from dorpatch_tpu.farm.report import format_fleet_report, summarize_fleet
+from dorpatch_tpu.farm.worker import (
+    FarmWorker, apply_overrides, classify_failure, job_config)
+
+SPEC = {
+    "base": {"dataset": "cifar10", "base_arch": "resnet18", "img_size": 32,
+             "batch_size": 2, "synthetic_data": True},
+    "axes": {"attack.patch_budget": [0.06, 0.12], "attack.dropout": [1, 2]},
+    "max_attempts": 3,
+}
+
+
+class FakeClock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+def _worker(farm_dir, runner, **kw):
+    kw.setdefault("lease_ttl", 5.0)
+    kw.setdefault("poll_interval", 0.02)
+    kw.setdefault("heartbeat_interval", 0.2)
+    kw.setdefault("backoff_base", 0.05)
+    kw.setdefault("backoff_cap", 0.2)
+    return FarmWorker(str(farm_dir), runner=runner, **kw)
+
+
+# ---------------- grid expansion / slugs / backoff ----------------
+
+
+def test_expand_grid_deterministic_sorted_order():
+    axes = {"b": [1, 2], "a": [0.1, 0.2]}
+    grid = expand_grid(axes)
+    assert grid == [
+        {"a": 0.1, "b": 1}, {"a": 0.1, "b": 2},
+        {"a": 0.2, "b": 1}, {"a": 0.2, "b": 2},
+    ]
+    assert expand_grid({}) == [{}]
+    assert expand_grid(dict(reversed(list(axes.items())))) == grid
+
+
+def test_job_slug_filesystem_safe():
+    slug = job_slug({"attack.patch_budget": 0.06, "base_arch": "res/net 18"})
+    assert "/" not in slug and " " not in slug
+    assert "patch_budget=0.06" in slug
+    assert len(job_slug({"k": "x" * 500})) <= 80
+
+
+def test_retry_delay_deterministic_exponential_capped():
+    assert retry_delay("j", 1) == retry_delay("j", 1)
+    assert retry_delay("j", 1) != retry_delay("other", 1)
+    base1 = retry_delay("j", 1, base=2.0, cap=300.0, jitter=0.0)
+    base3 = retry_delay("j", 3, base=2.0, cap=300.0, jitter=0.0)
+    assert base1 == 2.0 and base3 == 8.0
+    assert retry_delay("j", 50, base=2.0, cap=300.0, jitter=0.0) == 300.0
+    jittered = retry_delay("j", 1, base=2.0, jitter=0.25)
+    assert 2.0 <= jittered <= 2.5
+
+
+# ---------------- submit / job state ----------------
+
+
+def test_submit_expands_grid_and_is_idempotent(tmp_path):
+    jq = JobQueue(str(tmp_path / "farm"))
+    ids = jq.submit_spec(SPEC)
+    assert len(ids) == 4 and ids == sorted(ids)
+    job = jq.read_job(ids[0])
+    assert job["state"] == "pending" and job["attempts"] == 0
+    assert job["params"] == {"attack.dropout": 1,
+                             "attack.patch_budget": 0.06}
+
+    # resubmitting must not reset live state
+    jq.mark_running(dict(job), "w1")
+    ids2 = jq.submit_spec(SPEC)
+    assert ids2 == ids
+    assert jq.read_job(ids[0])["state"] == "running"
+    assert jq.read_job(ids[0])["attempts"] == 1
+
+
+def test_counts_and_drained(tmp_path):
+    jq = JobQueue(str(tmp_path / "farm"))
+    ids = jq.submit_spec(SPEC)
+    c = jq.counts()
+    assert c["total"] == 4 and c["pending"] == 4
+    assert not jq.drained(c)
+    for job_id in ids:
+        job = jq.read_job(job_id)
+        jq.mark_running(job, "w")
+        jq.mark_done(job, {"rows": 1})
+    c = jq.counts()
+    assert c["done"] == 4 and jq.drained(c)
+
+
+def test_job_config_applies_base_and_axes(tmp_path):
+    jq = JobQueue(str(tmp_path / "farm"))
+    ids = jq.submit_spec(SPEC)
+    cfg = job_config(jq.read_job(ids[-1]))
+    assert cfg.dataset == "cifar10" and cfg.synthetic_data
+    assert cfg.attack.patch_budget == 0.12 and cfg.attack.dropout == 2
+
+
+def test_apply_overrides_dotted_and_unknown():
+    cfg = ExperimentConfig()
+    out = apply_overrides(cfg, {"attack.patch_budget": 0.3, "batch_size": 7,
+                                "attack.dropout_sizes": [0.05, 0.1]})
+    assert out.attack.patch_budget == 0.3 and out.batch_size == 7
+    assert out.attack.dropout_sizes == (0.05, 0.1)
+    with pytest.raises(TypeError):
+        apply_overrides(cfg, {"attack.no_such_field": 1})
+    with pytest.raises(ValueError):
+        apply_overrides(cfg, {"attack.lr.inner": 1})
+
+
+# ---------------- lease protocol ----------------
+
+
+def test_lease_claim_is_exclusive_and_releasable(tmp_path):
+    jq = JobQueue(str(tmp_path / "farm"))
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 1})
+    assert jq.try_claim_lease(job_id, "wA", ttl=60.0)
+    assert not jq.try_claim_lease(job_id, "wB", ttl=60.0)
+    assert jq.owns_lease(job_id, "wA") and not jq.owns_lease(job_id, "wB")
+    assert jq.renew_lease(job_id, "wA", 60.0)
+    assert not jq.renew_lease(job_id, "wB", 60.0)
+    jq.release_lease(job_id, "wB")  # not the owner: must be a no-op
+    assert jq.owns_lease(job_id, "wA")
+    jq.release_lease(job_id, "wA")
+    assert jq.read_lease(job_id) is None
+
+
+def test_stale_lease_reclaimed_via_heartbeat(tmp_path):
+    clock = FakeClock(1000.0)
+    jq = JobQueue(str(tmp_path / "farm"), clock=clock)
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 3})
+    hb = tmp_path / "hb.jsonl"
+    hb.write_text(json.dumps({"ts": 1000.0, "seq": 0, "phase": "x"}) + "\n")
+    job = jq.claim("wA", ttl=10.0, heartbeat_path=str(hb))
+    assert job is not None and job["state"] == "leased"
+    jq.mark_running(job, "wA")
+
+    # within TTL and still beating: the lease holds
+    clock.now = 1005.0
+    assert jq.claim("wB", ttl=10.0) is None
+
+    # a fresh beat extends liveness even past the original expires_ts
+    clock.now = 1012.0
+    hb.write_text(hb.read_text()
+                  + json.dumps({"ts": 1011.0, "seq": 1, "phase": "x"}) + "\n")
+    assert jq.claim("wB", ttl=10.0) is None
+
+    # beats stop: the lease goes stale and wB reclaims, reclaim counted
+    clock.now = 1030.0
+    reclaimed = jq.claim("wB", ttl=10.0)
+    assert reclaimed is not None and reclaimed["id"] == job_id
+    assert reclaimed["worker"] == "wB" and reclaimed["reclaims"] == 1
+
+
+def test_stale_lease_without_heartbeat_uses_expires_ts(tmp_path):
+    clock = FakeClock(1000.0)
+    jq = JobQueue(str(tmp_path / "farm"), clock=clock)
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 3})
+    assert jq.claim("wA", ttl=10.0) is not None
+    clock.now = 1009.0
+    assert jq.claim("wB", ttl=10.0) is None
+    clock.now = 1011.0
+    reclaimed = jq.claim("wB", ttl=10.0)
+    assert reclaimed is not None and reclaimed["worker"] == "wB"
+
+
+def test_corrupt_lease_is_reclaimable(tmp_path):
+    jq = JobQueue(str(tmp_path / "farm"))
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 1})
+    with open(jq.lease_path(job_id), "w") as fh:
+        fh.write('{"worker": "wA", "expi')  # truncated mid-write
+    assert jq.try_claim_lease(job_id, "wB", ttl=60.0)
+    assert jq.owns_lease(job_id, "wB")
+
+
+def test_concurrent_claims_are_disjoint(tmp_path):
+    jq = JobQueue(str(tmp_path / "farm"))
+    ids = jq.submit_spec(SPEC)
+    claimed, lock = [], threading.Lock()
+    barrier = threading.Barrier(4)
+
+    def contend(worker_id):
+        q = JobQueue(str(tmp_path / "farm"))
+        barrier.wait()
+        while True:
+            job = q.claim(worker_id, ttl=60.0)
+            if job is None:
+                return
+            with lock:
+                claimed.append((worker_id, job["id"]))
+
+    threads = [threading.Thread(target=contend, args=(f"w{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(j for _, j in claimed) == ids  # every job exactly once
+
+
+# ---------------- retry / quarantine state machine ----------------
+
+
+def test_failed_respects_backoff_then_exhausts(tmp_path):
+    clock = FakeClock(1000.0)
+    jq = JobQueue(str(tmp_path / "farm"), clock=clock)
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 2})
+    job = jq.claim("wA", ttl=60.0)
+    job = jq.mark_running(job, "wA")
+    jq.mark_failed(job, {"kind": "io"}, next_retry_ts=1050.0)
+    jq.release_lease(job_id, "wA")
+
+    assert jq.counts()["failed_retryable"] == 1
+    assert jq.claim("wB", ttl=60.0) is None  # backoff not yet elapsed
+    clock.now = 1051.0
+    job = jq.claim("wB", ttl=60.0)
+    assert job is not None
+    job = jq.mark_running(job, "wB")
+    assert job["attempts"] == 2
+    jq.mark_failed(job, {"kind": "io"}, next_retry_ts=1100.0)
+    jq.release_lease(job_id, "wB")
+
+    c = jq.counts()
+    assert c["failed_exhausted"] == 1 and jq.drained(c)
+    clock.now = 2000.0
+    assert jq.claim("wC", ttl=60.0) is None  # exhausted is terminal
+
+
+def test_classify_failure_taxonomy():
+    assert classify_failure(SimulatedPreemption("x")) == ("preemption", True)
+    assert classify_failure(MemoryError()) == ("oom", True)
+    assert classify_failure(OSError(28, "no space")) == ("io", True)
+    assert classify_failure(FloatingPointError("nan")) == ("nan", False)
+    for exc in (TypeError("t"), ValueError("v"), KeyError("k"),
+                AttributeError("a"), IndexError("i")):
+        kind, transient = classify_failure(exc)
+        assert (kind, transient) == ("trace", False)
+    assert classify_failure(RuntimeError("???")) == ("unknown", True)
+
+    class RecompileBudgetExceeded(RuntimeError):
+        pass
+
+    assert classify_failure(RecompileBudgetExceeded()) == ("recompile", False)
+
+
+def test_worker_quarantines_deterministic_failure(tmp_path):
+    jq = JobQueue(str(tmp_path / "farm"))
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 3})
+
+    def bad_runner(job, ctx):
+        raise ValueError("shape mismatch in trace")
+
+    summary = _worker(tmp_path / "farm", bad_runner).run()
+    assert summary["quarantined"] == 1 and summary["done"] == 0
+    job = jq.read_job(job_id)
+    assert job["state"] == "quarantined"
+    assert job["attempts"] == 1  # no retries burned on a deterministic bug
+    failure = job["failures"][-1]
+    assert failure["kind"] == "trace" and not failure["transient"]
+    assert "ValueError" in failure["error"]
+    assert "bad_runner" in failure["traceback"]
+    assert jq.read_lease(job_id) is None
+
+
+def test_worker_retries_transient_until_exhausted(tmp_path):
+    jq = JobQueue(str(tmp_path / "farm"))
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 2})
+
+    def flaky_runner(job, ctx):
+        raise OSError(28, "disk full")
+
+    summary = _worker(tmp_path / "farm", flaky_runner).run()
+    assert summary["failed"] == 2
+    job = jq.read_job(job_id)
+    assert job["state"] == "failed" and job["exhausted"]
+    assert job["attempts"] == 2 and len(job["failures"]) == 2
+
+
+def test_worker_two_workers_drain_disjointly(tmp_path):
+    jq = JobQueue(str(tmp_path / "farm"))
+    ids = jq.submit_spec(SPEC)
+    ran, lock = [], threading.Lock()
+
+    def runner(job, ctx):
+        with lock:
+            ran.append(job["id"])
+        return {"rows": 1}
+
+    workers = [_worker(tmp_path / "farm", runner, worker_id=w)
+               for w in ("wA", "wB")]
+    threads = [threading.Thread(target=w.run) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(ran) == ids  # each job ran exactly once
+    c = jq.counts()
+    assert c["done"] == 4 and jq.drained(c)
+
+
+# ---------------- chaos harness ----------------
+
+
+def test_fault_seed_deterministic_and_fault_specific():
+    assert fault_seed("0001-x", "crash_block") == fault_seed("0001-x",
+                                                            "crash_block")
+    assert fault_seed("0001-x", "crash_block") != fault_seed("0002-y",
+                                                             "crash_block")
+    assert fault_seed("0001-x", "crash_block") != fault_seed("0001-x",
+                                                             "ckpt_raise")
+
+
+def test_parse_faults_rejects_unknown():
+    assert parse_faults("crash_block, ckpt_raise") == ("crash_block",
+                                                       "ckpt_raise")
+    with pytest.raises(ValueError, match="unknown chaos fault"):
+        parse_faults("crash_block,typo_fault")
+
+
+def test_chaos_fires_exactly_once_across_instances(tmp_path):
+    d = str(tmp_path / "job")
+    c1 = Chaos(("ckpt_raise",), "0000", d)
+    assert c1.fire_once("ckpt_raise")
+    assert not c1.fire_once("ckpt_raise")
+    # a fresh instance (post-SIGKILL restart) sees the persisted marker
+    c2 = Chaos(("ckpt_raise",), "0000", d)
+    assert c2.fired("ckpt_raise")
+    assert not c2.fire_once("ckpt_raise")
+    # faults not armed never fire
+    assert not c1.fire_once("enospc_events")
+
+
+def test_chaos_crash_raise_at_seeded_block(tmp_path):
+    job_id = "0000"
+    c = Chaos(("crash_block",), job_id, str(tmp_path / "job"),
+              crash_mode="raise")
+    ordinal = c.crash_block_ordinal()
+    for n in range(ordinal):
+        c.on_block(0, n)  # below the ordinal: no fault
+    with pytest.raises(SimulatedPreemption):
+        c.on_block(0, ordinal)
+    # replay after "restart": marker persists, no second crash
+    c2 = Chaos(("crash_block",), job_id, str(tmp_path / "job"),
+               crash_mode="raise")
+    for n in range(5):
+        c2.on_block(0, n)
+
+
+def test_chaos_ckpt_proxy_raises_enospc_once(tmp_path):
+    class FakeCk:
+        def __init__(self):
+            self.saves = []
+
+        def save(self, *a):
+            self.saves.append(a)
+
+        def latest_step_info(self):
+            return None
+
+    c = Chaos(("ckpt_raise",), "0000", str(tmp_path / "job"))
+    inner = FakeCk()
+    proxy = c.wrap_checkpointer(inner)
+    errors = 0
+    for i in range(4):
+        try:
+            proxy.save(0, i, "state")
+        except OSError as e:
+            assert e.errno == 28
+            errors += 1
+    assert errors == 1 and len(inner.saves) == 3
+    assert proxy.latest_step_info() is None  # delegation intact
+    # once fired, wrapping is a pass-through (retry attempt saves cleanly)
+    assert c.wrap_checkpointer(inner) is inner
+
+
+def test_worker_chaos_crash_raise_then_retry_to_done(tmp_path):
+    jq = JobQueue(str(tmp_path / "farm"))
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 3})
+
+    def runner(job, ctx):
+        for i in range(4):
+            ctx.on_block_end(0, i, {})
+        return {"rows": 1}
+
+    summary = _worker(tmp_path / "farm", runner, chaos="crash_block",
+                      crash_mode="raise").run()
+    assert summary["failed"] == 1 and summary["done"] == 1
+    job = jq.read_job(job_id)
+    assert job["state"] == "done" and job["attempts"] == 2
+    assert job["failures"][0]["kind"] == "preemption"
+    assert os.path.exists(os.path.join(jq.job_dir(job_id),
+                                       "chaos_crash_block.fired"))
+
+
+def test_worker_chaos_enospc_events_never_fatal(tmp_path):
+    jq = JobQueue(str(tmp_path / "farm"))
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 2})
+
+    def runner(job, ctx):
+        from dorpatch_tpu import observe
+        for i in range(12):  # well past any seeded write budget
+            with observe.span("farm.step", i=i):
+                pass
+        return {"rows": 1}
+
+    summary = _worker(tmp_path / "farm", runner,
+                      chaos="enospc_events").run()
+    assert summary["done"] == 1  # telemetry loss is never fatal
+    assert jq.read_job(job_id)["state"] == "done"
+
+
+def test_worker_wedge_heartbeat_job_reclaimed_by_healthy_worker(tmp_path):
+    """A live-zombie worker (heartbeat wedged, process alive) must lose its
+    lease to a contender and abandon the job without committing state; a
+    healthy worker then drains it."""
+    farm = tmp_path / "farm"
+    jq = JobQueue(str(farm))
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 3})
+    contender = JobQueue(str(farm))
+
+    def runner(job, ctx):
+        ctx.on_block_end(0, 0, {})  # wedge fires: beats freeze here
+        time.sleep(0.5)             # let the frozen heartbeat go stale
+        stolen = contender.claim("wThief", ttl=0.2)
+        assert stolen is not None and stolen["worker"] == "wThief"
+        ctx.on_block_end(0, 1, {})  # renewal sees the thief -> LeaseLost
+        raise AssertionError("unreachable: lease loss must abort the job")
+
+    wedged = _worker(farm, runner, worker_id="wZ", chaos="wedge_heartbeat",
+                     lease_ttl=0.3, heartbeat_interval=0.05)
+    summary_z = wedged.run()
+    assert summary_z["abandoned"] == 1 and summary_z.get("wedged") is True
+    # the abandoned commit left the thief's record intact
+    assert jq.read_job(job_id)["worker"] == "wThief"
+
+    time.sleep(0.3)  # the thief never beats; its lease expires by ttl
+
+    def ok_runner(job, ctx):
+        return {"rows": 1}
+
+    healthy = _worker(farm, ok_runner, worker_id="wH", lease_ttl=5.0)
+    healthy.run()
+    job = jq.read_job(job_id)
+    assert job["state"] == "done" and job["worker"] == "wH"
+    assert job["reclaims"] >= 1
+
+
+# ---------------- fleet report ----------------
+
+
+def _run_fleet(tmp_path):
+    farm = str(tmp_path / "farm")
+    jq = JobQueue(farm)
+    jq.submit_spec(SPEC)
+
+    def runner(job, ctx):
+        for i in range(3):  # block boundaries: lets crash_block chaos fire
+            ctx.on_block_end(0, i, {})
+        if job["params"]["attack.dropout"] == 2 \
+                and job["params"]["attack.patch_budget"] == 0.12:
+            raise ValueError("bad grid point")
+        from dorpatch_tpu.sweep import append_row
+        append_row(ctx.result_dir, {
+            "patch_budget": job["params"]["attack.patch_budget"],
+            "density": 0.0, "structured": 1e-3,
+            "robust_accuracy": 50.0, "certified_asr_pc": 25.0,
+            "asr": 50.0, "point": 0,
+        })
+        return {"rows": 1}
+
+    _worker(tmp_path / "farm", runner, chaos="crash_block",
+            crash_mode="raise").run()
+    return farm, jq
+
+
+def test_summarize_fleet_accounting(tmp_path):
+    farm, jq = _run_fleet(tmp_path)
+    fleet = summarize_fleet(farm)
+    assert fleet["counts"]["done"] == 3
+    assert fleet["counts"]["quarantined"] == 1
+    assert fleet["retries"] >= 1  # at least the chaos-crashed job retried
+    assert fleet["failures_by_kind"].get("trace") == 1
+    assert fleet["failures_by_kind"].get("preemption", 0) >= 1
+    assert len(fleet["quarantined"]) == 1
+    assert "ValueError" in fleet["quarantined"][0]["error"]
+    assert len(fleet["points"]) == 3
+    done = [j for j in fleet["jobs"] if j["state"] == "done"]
+    assert all(j["run_ids"] for j in done)  # manifest chain present
+    crashed = [j for j in fleet["jobs"] if j["attempts"] > 1]
+    assert crashed and len(crashed[0]["run_ids"]) == crashed[0]["attempts"]
+    assert summarize_fleet(str(tmp_path)) is None  # not a farm dir
+
+
+def test_format_fleet_report_sections(tmp_path):
+    farm, _ = _run_fleet(tmp_path)
+    text = format_fleet_report(summarize_fleet(farm))
+    assert "= DorPatch attack-sweep farm report =" in text
+    assert "-- farm --" in text and "-- jobs --" in text
+    assert "-- robust accuracy --" in text
+    assert "quarantined" in text and "robust acc 50.0%" in text
+
+
+def test_report_cli_dispatches_on_farm_dir(tmp_path, capsys):
+    from dorpatch_tpu.observe import report as report_cli
+
+    farm, _ = _run_fleet(tmp_path)
+    assert report_cli.main([farm]) == 0
+    out = capsys.readouterr().out
+    assert "-- farm --" in out and "attempts histogram" in out
+    assert report_cli.main([farm, "--json"]) == 0
+    fleet = json.loads(capsys.readouterr().out)
+    assert fleet["counts"]["done"] == 3
+
+
+def test_farm_cli_submit_status_roundtrip(tmp_path, capsys):
+    from dorpatch_tpu.farm.__main__ import main as farm_main
+
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(SPEC))
+    farm = str(tmp_path / "farm")
+    assert farm_main(["submit", farm, "--spec", str(spec_path)]) == 0
+    assert farm_main(["status", farm]) == 0
+    out = capsys.readouterr().out
+    assert '"jobs": 4' in out and '"pending": 4' in out
+
+
+# ---------------- end-to-end crash-resume parity (real attack) ----------------
+
+
+@pytest.mark.slow
+def test_farm_crash_resume_bit_identical_to_uninterrupted(tmp_path):
+    """Acceptance: under chaos (crash at a seeded block boundary), a farm
+    completes the job with final patch artifacts identical to an
+    uninterrupted run, and the retry resumed from the carry checkpoint
+    rather than restarting."""
+    import numpy as np
+
+    from dorpatch_tpu.sweep import run_sweep
+
+    attack = AttackConfig(
+        sampling_size=4, max_iterations=4, sweep_interval=2,
+        switch_iteration=2, dropout=1, dropout_sizes=(0.06,), basic_unit=4,
+    )
+    cfg = ExperimentConfig(
+        dataset="cifar10", base_arch="resnet18", img_size=32, batch_size=2,
+        synthetic_data=True, attack=attack,
+    )
+
+    # uninterrupted oracle, artifacts on disk
+    control_dir = str(tmp_path / "control")
+    run_sweep(cfg, patch_budgets=(0.1,), densities=(0.0,),
+              structureds=(1e-3,), defense_ratio=0.06, verbose=False,
+              result_dir=control_dir)
+
+    farm = str(tmp_path / "farm")
+    jq = JobQueue(farm)
+    (job_id,) = jq.submit_spec({
+        "base": {"dataset": "cifar10", "base_arch": "resnet18",
+                 "img_size": 32, "batch_size": 2, "synthetic_data": True,
+                 "attack": {"sampling_size": 4, "max_iterations": 4,
+                            "sweep_interval": 2, "switch_iteration": 2,
+                            "dropout": 1, "dropout_sizes": [0.06],
+                            "basic_unit": 4}},
+        "axes": {"attack.patch_budget": [0.1]},
+        "sweep": {"densities": [0.0], "structureds": [1e-3],
+                  "defense_ratio": 0.06},
+        "max_attempts": 3,
+    })
+    worker = FarmWorker(farm, worker_id="wE", lease_ttl=30.0,
+                        poll_interval=0.05, heartbeat_interval=0.5,
+                        backoff_base=0.05, backoff_cap=0.2,
+                        chaos="crash_block", crash_mode="raise")
+    summary = worker.run()
+    assert summary["done"] == 1 and summary["failed"] == 1
+
+    job = jq.read_job(job_id)
+    assert job["state"] == "done" and job["attempts"] == 2
+    assert job["result"]["rows"] == 1
+    # the retry resumed from the crashed attempt's carry snapshot
+    assert job["result"]["resumed_points"] == 1
+
+    result_dir = os.path.join(jq.job_dir(job_id), "results")
+    for name in ("point_000_mask.npy", "point_000_pattern.npy"):
+        got = np.load(os.path.join(result_dir, name))
+        want = np.load(os.path.join(control_dir, name))
+        np.testing.assert_array_equal(got, want)
+
+    fleet = summarize_fleet(farm)
+    assert fleet["retries"] == 1
+    text = format_fleet_report(fleet)
+    assert "resumed" in text

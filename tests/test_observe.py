@@ -301,3 +301,49 @@ def test_shared_run_id_single_process():
     from dorpatch_tpu.parallel import multiproc
 
     assert multiproc.shared_run_id("abc123def456") == "abc123def456"
+
+
+def test_last_beat_ts_tolerates_truncated_final_line(tmp_path):
+    """`last_beat_ts` is the farm's lease-liveness primitive: it must return
+    the newest *parseable* beat even when the final line was torn by the
+    very crash it exists to detect."""
+    path = tmp_path / "heartbeat_0.jsonl"
+    assert observe.last_beat_ts(str(path)) is None  # missing file
+    path.write_text("")
+    assert observe.last_beat_ts(str(path)) is None  # empty file
+    beats = [{"ts": 100.0, "seq": 0, "phase": "a"},
+             {"ts": 101.5, "seq": 1, "phase": "b"}]
+    path.write_text("".join(json.dumps(b) + "\n" for b in beats))
+    assert observe.last_beat_ts(str(path)) == 101.5
+    with open(path, "a") as fh:
+        fh.write('{"ts": 999.0, "se')  # SIGKILL mid-write
+    assert observe.last_beat_ts(str(path)) == 101.5
+    with open(path, "ab") as fh:  # torn mid-multibyte-char: must not raise
+        fh.write("\n".encode() + "é".encode()[:1])
+    assert observe.last_beat_ts(str(path)) == 101.5
+
+
+def test_read_heartbeats_skips_partial_lines(tmp_path):
+    beats = [{"ts": 1.0, "seq": 0, "phase": "x", "proc": 0}]
+    path = tmp_path / "heartbeat_0.jsonl"
+    with open(path, "wb") as fh:
+        for b in beats:
+            fh.write((json.dumps(b) + "\n").encode())
+        fh.write(b'{"ts": 2.0, "seq": 1, "ph')
+        fh.write("é".encode()[:1])  # truncated multibyte tail
+    got = observe.read_heartbeats(str(tmp_path))
+    assert [b["seq"] for b in got["heartbeat_0.jsonl"]] == [0]
+
+
+def test_heartbeat_wedge_freezes_file_without_exit_beat(tmp_path):
+    """After `wedge()` the file must look exactly like a process stuck in a
+    device call: no further beats, and no clean `exit` beat on close."""
+    path = str(tmp_path / "heartbeat_0.jsonl")
+    with observe.Heartbeat(path, get_phase=lambda: "busy",
+                           interval=0.01) as hb:
+        hb.beat()
+        hb.wedge()
+        frozen = observe.last_beat_ts(path)
+    beats = observe.read_heartbeats(str(tmp_path))["heartbeat_0.jsonl"]
+    assert observe.last_beat_ts(path) == frozen
+    assert all(b["phase"] != "exit" for b in beats)

@@ -80,3 +80,46 @@ def test_sweep_cli_smoke(capsys):
     assert len(rows) == 1
     out = capsys.readouterr().out
     assert '"sweep_points": 1' in out
+
+
+def test_rows_jsonl_roundtrip_and_truncation(tmp_path):
+    """Incremental row persistence: appended rows survive a round-trip with
+    keys stable across JSON float formatting, and a final line truncated by
+    a kill is dropped (that point simply re-runs)."""
+    from dorpatch_tpu.sweep import (
+        ROWS_NAME, append_row, load_recorded_rows, row_key)
+
+    d = str(tmp_path / "res")
+    assert load_recorded_rows(d) == {}  # missing file: nothing recorded
+    row_a = {"patch_budget": 0.1, "density": 0.0, "structured": 1e-3,
+             "robust_accuracy": 42.0, "point": 0}
+    row_b = {"patch_budget": 0.2, "density": 0.0, "structured": 1e-3,
+             "robust_accuracy": 17.0, "point": 1}
+    append_row(d, row_a)
+    append_row(d, row_b)
+    recorded = load_recorded_rows(d)
+    assert recorded[row_key(0.1, 0.0, 1e-3)] == row_a
+    assert recorded[row_key(0.2, 0.0, 1e-3)] == row_b
+
+    # torn final line (killed mid-append): earlier rows still load
+    with open(tmp_path / "res" / ROWS_NAME, "a") as fh:
+        fh.write('{"patch_budget": 0.3, "den')
+    recorded = load_recorded_rows(d)
+    assert len(recorded) == 2
+    assert row_key(0.3, 0.0, 1e-3) not in recorded
+
+    # rows missing grid keys (e.g. foreign jsonl) are ignored, not fatal
+    with open(tmp_path / "res" / ROWS_NAME, "a") as fh:
+        fh.write('\n{"unrelated": true}\n')
+    assert len(load_recorded_rows(d)) == 2
+
+
+def test_row_key_stable_across_json_roundtrip():
+    import json as _json
+
+    from dorpatch_tpu.sweep import GRID_KEYS, row_key
+
+    row = dict(zip(GRID_KEYS, (0.1, 1e-3, 3.3333333333333335e-05)))
+    back = _json.loads(_json.dumps(row))
+    assert row_key(*(back[k] for k in GRID_KEYS)) == \
+        row_key(*(row[k] for k in GRID_KEYS))

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Farm smoke: SIGKILL crash-resume, end to end (CI gate, `run_tests.sh`).
+
+The scenario the farm exists for, executed for real with separate worker
+processes over a shared farm directory:
+
+1. submit a 4-job attack-sweep grid (tiny synthetic cifar10/resnet18@32);
+2. a chaos worker (`--chaos crash_block --crash-mode kill`) claims the
+   first job and SIGKILLs itself at a seeded attack-block boundary — after
+   the block's carry snapshot was saved, before the job could complete;
+3. two healthy workers then drain the farm concurrently: one of them
+   reclaims the dead worker's job via heartbeat-stale lease takeover and
+   *resumes it from the checkpoint*;
+4. a control `run_sweep` runs the killed job's grid point uninterrupted in
+   this process.
+
+Asserts: every job `done`, zero jobs lost; the killed job shows
+attempts == 2, reclaims == 1, and a resumed point (steps not re-run from
+zero); its final patch artifacts are bit-identical to the control run; and
+the fleet report renders with the retry accounting.
+
+Prints ONE JSON line: {"metric": "farm_smoke", "ok": true, ...}; exits
+non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ATTACK = {"sampling_size": 4, "max_iterations": 4, "sweep_interval": 2,
+          "switch_iteration": 2, "dropout": 1, "dropout_sizes": [0.06],
+          "basic_unit": 4}
+BASE = {"dataset": "cifar10", "base_arch": "resnet18", "img_size": 32,
+        "batch_size": 2, "synthetic_data": True, "attack": ATTACK}
+BUDGETS = [0.08, 0.1, 0.12, 0.15]
+SWEEP = {"densities": [0.0], "structureds": [1e-3], "defense_ratio": 0.06}
+LEASE_TTL = 5.0
+
+
+def _work_cmd(farm_dir, worker_id, extra=()):
+    return [sys.executable, "-m", "dorpatch_tpu.farm", "work", farm_dir,
+            "--worker-id", worker_id, "--lease-ttl", str(LEASE_TTL),
+            "--heartbeat-interval", "0.25", "--poll-interval", "0.25",
+            "--backoff-base", "0.5", "--backoff-cap", "2.0",
+            *extra]
+
+
+def main(argv=None) -> int:
+    workdir = tempfile.mkdtemp(prefix="farm_smoke_")
+    farm_dir = os.path.join(workdir, "farm")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               # one shared XLA compile cache: the four processes (killer,
+               # two drainers, this control run) compile each program once
+               JAX_COMPILATION_CACHE_DIR=os.path.join(workdir, "xla_cache"))
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = env["JAX_COMPILATION_CACHE_DIR"]
+
+    from dorpatch_tpu.farm.queue import JobQueue
+    from dorpatch_tpu.farm.report import format_fleet_report, summarize_fleet
+
+    jq = JobQueue(farm_dir)
+    ids = jq.submit_spec({"base": BASE,
+                          "axes": {"attack.patch_budget": BUDGETS},
+                          "sweep": SWEEP, "max_attempts": 3})
+
+    failures = []
+
+    # ---- phase 1: the doomed worker (claims the first job, SIGKILLs) ----
+    killer = subprocess.run(
+        _work_cmd(farm_dir, "wKill",
+                  ("--chaos", "crash_block", "--crash-mode", "kill",
+                   "--max-jobs", "1")),
+        env=env, capture_output=True, text=True, timeout=600)
+    if killer.returncode != -signal.SIGKILL:
+        failures.append(
+            f"chaos worker exited {killer.returncode}, expected SIGKILL "
+            f"(-9); stderr tail: {killer.stderr[-800:]}")
+    killed = jq.read_job(ids[0])
+    if killed["state"] != "running" or killed["attempts"] != 1:
+        failures.append(
+            "after SIGKILL the job should be orphaned mid-run "
+            f"(state=running, attempts=1), got state={killed['state']} "
+            f"attempts={killed['attempts']}")
+    ck_root = os.path.join(jq.job_dir(ids[0]), "checkpoints", "carry_0")
+    if not os.path.isdir(ck_root) or not os.listdir(ck_root):
+        failures.append("no carry snapshot survived the SIGKILL — nothing "
+                        "for the reclaimer to resume from")
+
+    # ---- phase 2: two healthy workers drain the farm concurrently ----
+    drainers = [subprocess.Popen(_work_cmd(farm_dir, w), env=env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT, text=True)
+                for w in ("wA", "wB")]
+    deadline = time.time() + 1200
+    for proc in drainers:
+        out, _ = proc.communicate(timeout=max(30, deadline - time.time()))
+        if proc.returncode != 0:
+            failures.append(f"drain worker exited {proc.returncode}; "
+                            f"output tail: {out[-800:]}")
+
+    counts = jq.counts()
+    if counts["done"] != len(ids):
+        failures.append(f"jobs lost: expected {len(ids)} done, got {counts}")
+    killed = jq.read_job(ids[0])
+    if killed.get("attempts") != 2:
+        failures.append("killed job should show attempts == 2 (one life per "
+                        f"worker), got {killed.get('attempts')}")
+    if killed.get("reclaims", 0) != 1:
+        failures.append(
+            f"killed job should show reclaims == 1, got {killed.get('reclaims')}")
+    result = killed.get("result", {})
+    if result.get("resumed_points") != 1:
+        failures.append("reclaimed job must resume from the carry snapshot, "
+                        f"not restart: result={result}")
+
+    # ---- phase 3: uninterrupted control run of the killed grid point ----
+    from dorpatch_tpu.config import config_from_dict
+    from dorpatch_tpu.sweep import run_sweep
+
+    control_dir = os.path.join(workdir, "control")
+    cfg = config_from_dict(dict(BASE))
+    run_sweep(cfg, patch_budgets=(BUDGETS[0],),
+              densities=tuple(SWEEP["densities"]),
+              structureds=tuple(SWEEP["structureds"]),
+              defense_ratio=SWEEP["defense_ratio"], verbose=False,
+              result_dir=control_dir)
+
+    import numpy as np
+
+    result_dir = os.path.join(jq.job_dir(ids[0]), "results")
+    for name in ("point_000_mask.npy", "point_000_pattern.npy"):
+        got = np.load(os.path.join(result_dir, name))
+        want = np.load(os.path.join(control_dir, name))
+        if not np.array_equal(got, want):
+            failures.append(f"{name}: crash-resumed artifact differs from "
+                            "the uninterrupted control run")
+
+    # ---- phase 4: the fleet report must render the accounting ----
+    fleet = summarize_fleet(farm_dir)
+    text = format_fleet_report(fleet)
+    for needle in ("-- farm --", "-- jobs --", "-- robust accuracy --"):
+        if needle not in text:
+            failures.append(f"fleet report missing section {needle!r}")
+    if fleet["retries"] < 1 or fleet["reclaims"] < 1:
+        failures.append(f"fleet accounting lost the crash: retries="
+                        f"{fleet['retries']} reclaims={fleet['reclaims']}")
+
+    print(json.dumps({
+        "metric": "farm_smoke",
+        "ok": not failures,
+        "jobs": len(ids),
+        "done": counts["done"],
+        "killed_job_attempts": killed.get("attempts"),
+        "killed_job_reclaims": killed.get("reclaims"),
+        "resumed_points": result.get("resumed_points"),
+        "retries": fleet["retries"],
+        "wasted_s": fleet["step_time"]["wasted_s"],
+        "useful_s": fleet["step_time"]["useful_s"],
+        "failures": failures,
+    }, default=float))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        print(f"farm dir kept for debugging: {workdir}", file=sys.stderr)
+        return 1
+    shutil.rmtree(workdir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
