@@ -35,7 +35,9 @@ re-enabled automatically on OOM) re-executes the forward, so its extra FLOPs
 are real but not "useful" — MFU is reported on the 3x count either way.
 
 Env overrides: BENCH_MODE ("attack" default; "certify" times the
-PatchCleanser 666-mask certification path instead — see `_certify_bench`),
+PatchCleanser 666-mask certification path instead — see `_certify_bench`;
+"boot" measures cold vs AOT-warm serve boot wall-clock against a throwaway
+executable store — see `child_boot`),
 BENCH_BATCH (default 4), BENCH_EOT (128 — the reference sampling_size;
 r03 measured batch 4 x EOT 128 fitting v5e HBM without remat), BENCH_BLOCK (8 steps
 per jitted block), BENCH_REPS (3 timed blocks), BENCH_WARMUP (3 untimed
@@ -664,6 +666,77 @@ def run_child(role: str, timeout_s: int, env_extra: dict):
         return None, "no-json", err[-4000:]
 
 
+def child_boot() -> None:
+    """BENCH_MODE=boot child: cold vs AOT-warm serve boot wall-clock.
+
+    Boots the certified-inference service three times against one
+    throwaway store with a tiny stub victim: (1) cold — no store, every
+    serving program traces and compiles in-process; (2) build — mode
+    "auto" against the empty store compiles once more and populates it;
+    (3) warm — a FRESH service in mode "strict" boots purely from the
+    store (any miss raises AotBootError, so a finishing warm boot is the
+    proof). The printed line carries the warm service's total trace count:
+    0 is the zero-trace contract the serve smoke also asserts."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu.aot.store import ExecutableStore
+    from dorpatch_tpu.config import AotConfig, DefenseConfig, ServeConfig
+    from dorpatch_tpu.serve.service import CertifiedInferenceService
+
+    # a FRESH stub closure per service: jax.jit shares its trace cache
+    # across wrappers of the same function object, so one shared apply_fn
+    # would leak the cold boot's trace counts into the warm service's
+    # zero-trace accounting
+    def make_apply():
+        def apply_fn(params, x):
+            s = x.mean(axis=(1, 2, 3))
+            return jax.nn.one_hot((s * 7.0).astype(jnp.int32) % 5, 5)
+        return apply_fn
+
+    serve_cfg = ServeConfig(max_batch=4, bucket_sizes=(1, 4))
+    defense_cfg = DefenseConfig(ratios=(0.06,), chunk_size=64)
+
+    def make(aot_cfg):
+        return CertifiedInferenceService(
+            make_apply(), None, 5, 32, serve_cfg=serve_cfg,
+            defense_cfg=defense_cfg, aot_cfg=aot_cfg)
+
+    store_dir = tempfile.mkdtemp(prefix="bench-aot-")
+    try:
+        svc = make(None)
+        t0 = time.perf_counter()
+        svc.start()
+        cold_s = time.perf_counter() - t0
+        svc.stop()
+
+        builder = make(AotConfig(cache_dir=store_dir, mode="auto"))
+        builder.start()
+        builder.stop()
+
+        svcw = make(AotConfig(cache_dir=store_dir, mode="strict"))
+        t0 = time.perf_counter()
+        svcw.start()
+        warm_s = time.perf_counter() - t0
+        stats = dict(svcw._aot_stats or {})
+        warm_traces = sum(svcw.trace_counts().values())
+        svcw.stop()
+        print(json.dumps({
+            "cold_s": round(cold_s, 3),
+            "warm_s": round(warm_s, 3),
+            "aot": {"hits": int(stats.get("hits", 0)),
+                    "misses": int(stats.get("misses", 0)),
+                    "builds": int(stats.get("builds", 0)),
+                    "store_state": ExecutableStore(store_dir).state_hash()},
+            "warm_trace_count": int(warm_traces),
+        }))
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+
 def no_axon_env() -> dict:
     """Env that forces plain CPU jax: axon plugin off the path, cpu platform."""
     pp = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
@@ -678,11 +751,50 @@ def no_axon_env() -> dict:
 def main() -> None:
     # empty string = unset (the same convention as PALLAS_AXON_POOL_IPS)
     mode = os.environ.get("BENCH_MODE") or "attack"
-    if mode not in ("attack", "certify"):
+    if mode not in ("attack", "certify", "boot"):
         print(json.dumps({"metric": "patch-opt images/sec", "value": 0.0,
                           "unit": "images/sec", "vs_baseline": 0.0,
                           "error": f"unknown BENCH_MODE={mode!r} "
-                                   "(use 'attack' or 'certify')"}))
+                                   "(use 'attack', 'certify' or 'boot')"}))
+        return
+    if mode == "boot":
+        # Cold vs AOT-warm serve boot on one throwaway store. One CPU child
+        # (serialized executables are backend-specific; CPU keeps the row
+        # reproducible and independent of tunnel health), no torch baseline
+        # — vs_baseline is the cold/warm speedup of the SAME boot.
+        boot_metric = "serve warm-boot seconds (AOT executable store, stub victim)"
+        res, why, _tail = run_child(
+            "boot", int(os.environ.get("BENCH_JAX_TIMEOUT", "1800")),
+            no_axon_env())
+        if res is None:
+            print(json.dumps({"metric": boot_metric, "value": 0.0,
+                              "unit": "seconds", "vs_baseline": 0.0,
+                              "error": f"boot child failed ({why})"}))
+            return
+        out = {
+            "metric": boot_metric,
+            "value": res["warm_s"],
+            "unit": "seconds",
+            # >1.0 = warm boot reached serving-ready faster than cold
+            "vs_baseline": (round(res["cold_s"] / res["warm_s"], 2)
+                            if res.get("warm_s") else 0.0),
+            "cold_s": res["cold_s"],
+            "warm_s": res["warm_s"],
+            # store hit/miss/build counts + content hash, next to the
+            # program_set stamp below: the row names both the executables
+            # it loaded and the jit programs they were compiled from
+            "aot": res.get("aot"),
+            "warm_trace_count": res.get("warm_trace_count"),
+        }
+        try:
+            from dorpatch_tpu.analysis.baseline import program_set_stamp
+
+            stamp = program_set_stamp()
+            if stamp is not None:
+                out["program_set"] = stamp
+        except Exception:
+            pass
+        print(json.dumps(out))
         return
     # mode is validated: label misconfiguration rows with the right series
     err_metric = ("PatchCleanser certifications/sec" if mode == "certify"
@@ -866,5 +978,7 @@ if __name__ == "__main__":
         child_jax()
     elif role == "torch":
         child_torch()
+    elif role == "boot":
+        child_boot()
     else:
         main()

@@ -109,6 +109,11 @@ BASELINE_RULE_ROWS: Tuple[Tuple[str, str, str], ...] = (
     ("DP304", "interface-drift",
      "input/output aval, weak-type, or donation drift with an UNCHANGED "
      "body fingerprint — poisons an AOT executable cache key"),
+    ("DP305", "aot-store-drift",
+     "AOT executable store manifest disagrees with analysis/baselines.json "
+     "— stale or missing entry, corrupt payload, or build-env/topology "
+     "mismatch; rebuild with `python -m dorpatch_tpu.aot build` (emitted "
+     "by `python -m dorpatch_tpu.aot verify`)"),
 )
 
 BASELINE_RULE_IDS: Tuple[str, ...] = tuple(r[0] for r in BASELINE_RULE_ROWS)

@@ -1,0 +1,34 @@
+"""AOT executable store: zero-trace warm boot for serve and farm.
+
+Deploy-time `python -m dorpatch_tpu.aot build` compiles and serializes every
+production program keyed by its PR 8 baseline fingerprint + interface hash
+and the build environment (jax version, backend, device-topology hash);
+boot-time `warm_boot` / `FirstCallAotResolver` deserialize-and-install
+instead of tracing. See README "AOT executable store".
+"""
+
+from dorpatch_tpu.aot.boot import (  # noqa: F401
+    AotBootError,
+    AotDispatcher,
+    FirstCallAotResolver,
+    call_signature,
+    warm_boot,
+)
+from dorpatch_tpu.aot.store import (  # noqa: F401
+    ExecutableStore,
+    current_env,
+    open_readonly,
+    topology_hash,
+)
+
+__all__ = [
+    "AotBootError",
+    "AotDispatcher",
+    "ExecutableStore",
+    "FirstCallAotResolver",
+    "call_signature",
+    "current_env",
+    "open_readonly",
+    "topology_hash",
+    "warm_boot",
+]

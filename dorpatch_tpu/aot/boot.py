@@ -1,0 +1,399 @@
+"""Warm-boot loader: install serialized executables instead of tracing.
+
+Three pieces:
+
+* `AotDispatcher` — a drop-in replacement for a jitted program, swapped into
+  `observe._FirstCallTimer.__wrapped__`. It routes each call by a structural
+  signature (pytree shape + per-leaf shape/dtype, static leaves by repr) to a
+  pre-loaded executable; unknown signatures fall through to the original jit
+  (one `aot.miss` event per signature, the armed recompile watchdog still
+  accounts the trace). `_cache_size()` delegates to the fallback jit, so
+  `trace_counts()` reads 0 on a fully warm path — the zero-trace proof is
+  mechanical, not asserted.
+
+* `warm_boot(programs, aot_cfg)` — eager loader for serve: for every
+  (name, timer, abstract_args) triple from `trace_entrypoints()`, trace
+  abstractly (no compile), fingerprint via the PR 8 baseline canonicalizer,
+  look the record up in the store, and deserialize-and-install. Misses under
+  `strict` raise `AotBootError` (deploy mode: boot fails rather than
+  compiling); under `auto` they compile-and-rewrite the store entry — a
+  fingerprint/topology/corrupt mismatch is never served stale.
+
+* `FirstCallAotResolver` — the lazy, read-only variant for farm workers,
+  installed through `observe.set_aot_resolver`: on a timer's first call it
+  substitutes a store-backed dispatcher that resolves executables by
+  fingerprint on demand and never writes (no multi-worker write races).
+
+Serialization uses `jax.experimental.serialize_executable`; only the payload
+bytes are persisted — in/out pytree defs are rebuilt from a fresh abstract
+trace at load time, which is exactly the fingerprint check's trace, so a hit
+costs one trace-cache-free abstract trace + deserialize.
+"""
+
+from __future__ import annotations
+
+import time
+import types
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from dorpatch_tpu import observe
+from dorpatch_tpu.aot.store import ExecutableStore
+
+
+class AotBootError(RuntimeError):
+    """Strict-mode warm boot failed: a program missed the store."""
+
+
+def call_signature(args: tuple, kwargs: dict) -> Tuple[str, tuple]:
+    """Structural dispatch key, identical for abstract example args
+    (ShapeDtypeStruct) and the concrete arrays of a live call: pytree
+    structure, per-array (shape, dtype), non-array leaves by repr (a static
+    value that changes the program changes the key — a different static can
+    miss, it can never hit the wrong executable)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((tuple(args), dict(kwargs)))
+    sig = []
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sig.append(
+                ("a", tuple(int(d) for d in leaf.shape), str(leaf.dtype))
+            )
+        else:
+            sig.append(("s", repr(leaf)))
+    return (str(treedef), tuple(sig))
+
+
+def _interface_sha(traced) -> str:
+    from dorpatch_tpu.analysis import baseline as baseline_mod
+
+    ctx = types.SimpleNamespace(jaxpr=traced.jaxpr, args_info=traced.args_info)
+    return baseline_mod.interface_record(ctx)["sha"]
+
+
+def _static_positions(args: tuple, kwargs: dict, traced) -> Optional[tuple]:
+    """Which positional args were declared static (dropped from args_info)?
+    Validated structurally: dropping the candidates must reproduce the traced
+    args_info pytree exactly, else the program is refused as unsupported
+    (never guess which inputs an executable expects)."""
+    import jax
+
+    target = jax.tree_util.tree_structure(traced.args_info)
+    if jax.tree_util.tree_structure((tuple(args), dict(kwargs))) == target:
+        return ()
+    cand = tuple(
+        i
+        for i, a in enumerate(args)
+        if jax.tree_util.tree_leaves(a)
+        and not any(
+            hasattr(l, "shape") and hasattr(l, "dtype")
+            for l in jax.tree_util.tree_leaves(a)
+        )
+    )
+    dropped = tuple(a for i, a in enumerate(args) if i not in cand)
+    if jax.tree_util.tree_structure((dropped, dict(kwargs))) == target:
+        return cand
+    return None
+
+
+def _materialize(store: ExecutableStore, entry: Dict[str, Any],
+                 payload: bytes, traced):
+    """Turn a store hit into a callable: deserialize the blob, or for
+    persistent_cache entries AOT-compile against the store's XLA disk cache
+    (a cache hit inside XLA; the jit's own trace cache stays untouched)."""
+    if entry.get("method") == "persistent_cache":
+        from dorpatch_tpu import utils
+
+        utils.enable_compilation_cache(store.xla_cache_dir)
+        return traced.lower().compile()
+    import jax
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    in_tree = jax.tree_util.tree_flatten(traced.args_info)[1]
+    out_tree = jax.tree_util.tree_structure(traced.out_info)
+    return deserialize_and_load(payload, in_tree, out_tree)
+
+
+def _serialize_payload(compiled) -> Tuple[str, bytes]:
+    """(method, payload): serialized bytes when the backend supports
+    executable serialization, else the persistent_cache marker."""
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        return "serialized", serialize(compiled)[0]
+    except Exception:
+        return "persistent_cache", b""
+
+
+class AotDispatcher:
+    """Stands in for a jitted program behind a `_FirstCallTimer`.
+
+    Holds {call signature: (executable, static_positions)}. Known signatures
+    run the pre-loaded executable (statics dropped, as AOT executables take
+    only the dynamic operands); unknown ones optionally try a lazy read-only
+    store load (farm path), then fall back to the original jit with a single
+    `aot.miss` event per signature. All other attributes — `_cache_size`,
+    `trace`, `lower` — delegate to the fallback jit, so watchdog and
+    trace-count accounting see straight through to the real trace cache.
+    """
+
+    def __init__(self, fallback, name: str,
+                 store: Optional[ExecutableStore] = None,
+                 stats: Optional[Dict[str, Any]] = None):
+        self.fallback = fallback
+        self._name = name
+        self._store = store
+        self._stats = stats
+        self._table: Dict[Tuple[str, tuple], Tuple[Any, tuple]] = {}
+        self._missed: set = set()
+
+    def install(self, sig, executable, static_pos: tuple) -> None:
+        self._table[sig] = (executable, static_pos)
+
+    def installed(self) -> int:
+        return len(self._table)
+
+    def __call__(self, *args, **kwargs):
+        sig = call_signature(args, kwargs)
+        entry = self._table.get(sig)
+        if entry is None:
+            if sig not in self._missed:
+                reason = "signature"
+                if self._store is not None:
+                    entry, reason = self._lazy_load(sig, args, kwargs)
+                if entry is None:
+                    self._missed.add(sig)
+                    observe.record_event(
+                        "aot.miss", program=self._name, reason=reason
+                    )
+                    if self._stats is not None:
+                        self._stats["misses"] = (
+                            self._stats.get("misses", 0) + 1
+                        )
+                        self._stats.setdefault("programs", {}).setdefault(
+                            self._name, f"miss:{reason}"
+                        )
+            if entry is None:
+                return self.fallback(*args, **kwargs)
+        executable, static_pos = entry
+        if static_pos:
+            args = tuple(a for i, a in enumerate(args) if i not in static_pos)
+        return executable(*args, **kwargs)
+
+    def _lazy_load(self, sig, args, kwargs):
+        """Read-only on-demand resolution (farm): trace abstractly, look up
+        by fingerprint, install on hit. Returns (table entry | None, miss
+        reason); never raises into the caller — the caller records the miss
+        exactly once per signature."""
+        try:
+            from dorpatch_tpu.analysis import baseline as baseline_mod
+
+            t0 = time.perf_counter()
+            traced = self.fallback.trace(*args, **kwargs)
+            fp = baseline_mod.fingerprint(traced.jaxpr)
+            payload, entry, reason = self._store.lookup_by_fingerprint(
+                fp, _interface_sha(traced)
+            )
+            if reason is not None:
+                return None, reason
+            static_pos = _static_positions(args, kwargs, traced)
+            if static_pos is None:
+                return None, "unsupported"
+            executable = _materialize(self._store, entry, payload, traced)
+            self._table[sig] = (executable, static_pos)
+            load_s = round(time.perf_counter() - t0, 6)
+            if self._stats is not None:
+                self._stats["hits"] = self._stats.get("hits", 0) + 1
+                self._stats["load_s"] = round(
+                    self._stats.get("load_s", 0.0) + load_s, 6
+                )
+                self._stats.setdefault("programs", {})[self._name] = "hit"
+            observe.record_event(
+                "aot.load", program=self._name,
+                method=entry.get("method", "serialized"), load_s=load_s,
+                lazy=True,
+            )
+            return self._table[sig], None
+        except Exception as e:
+            return None, f"error:{type(e).__name__}"
+
+    def __getattr__(self, item):
+        fallback = self.__dict__.get("fallback")
+        if fallback is None:
+            raise AttributeError(item)
+        return getattr(fallback, item)
+
+
+def _note_miss(stats: Dict[str, Any], name: str, reason: str,
+               mode: str) -> None:
+    stats["misses"] += 1
+    stats["miss_reasons"][reason] = stats["miss_reasons"].get(reason, 0) + 1
+    stats["programs"][name] = f"miss:{reason}"
+    observe.record_event("aot.miss", program=name, reason=reason)
+    if mode == "strict":
+        raise AotBootError(
+            f"[{name}] AOT store miss ({reason}) under strict warm boot — "
+            "rebuild the store (`python -m dorpatch_tpu.aot build`) or boot "
+            "with --aot auto"
+        )
+
+
+def _boot_one(store: ExecutableStore, disp: AotDispatcher, fallback,
+              name: str, args: tuple, mode: str, stats: Dict[str, Any],
+              clock) -> bool:
+    """Load-or-rebuild one program; returns True when the store was
+    mutated (auto-mode rewrite)."""
+    from dorpatch_tpu.analysis import baseline as baseline_mod
+
+    try:
+        traced = fallback.trace(*args)
+        fp = baseline_mod.fingerprint(traced.jaxpr)
+        iface = _interface_sha(traced)
+        static_pos = _static_positions(args, {}, traced)
+        sig = call_signature(args, {})
+    except AotBootError:
+        raise
+    except Exception as e:
+        _note_miss(stats, name, f"untraceable:{type(e).__name__}", mode)
+        return False
+    if static_pos is None:
+        _note_miss(stats, name, "unsupported", mode)
+        return False
+    payload, entry, reason = store.lookup(name, fp, iface)
+    if reason is None:
+        t0 = clock()
+        try:
+            executable = _materialize(store, entry, payload, traced)
+        except Exception:
+            reason = "corrupt"
+        else:
+            load_s = clock() - t0
+            disp.install(sig, executable, static_pos)
+            saved = max(
+                0.0, float(entry.get("build_compile_s", 0.0)) - load_s
+            )
+            stats["hits"] += 1
+            stats["load_s"] = round(stats["load_s"] + load_s, 6)
+            stats["saved_s"] = round(stats["saved_s"] + saved, 6)
+            stats["programs"][name] = "hit"
+            observe.record_event(
+                "aot.load", program=name,
+                method=entry.get("method", "serialized"),
+                load_s=round(load_s, 6), saved_s=round(saved, 3),
+            )
+            return False
+    _note_miss(stats, name, reason, mode)  # raises under strict
+    t0 = clock()
+    compiled = traced.lower().compile()
+    compile_s = clock() - t0
+    method, payload_out = _serialize_payload(compiled)
+    if method == "persistent_cache":
+        from dorpatch_tpu import utils
+
+        utils.enable_compilation_cache(store.xla_cache_dir)
+        traced.lower().compile()
+    store.put(name, fp, iface, method, payload_out, compile_s)
+    disp.install(sig, compiled, static_pos)
+    stats["builds"] += 1
+    stats["build_s"] = round(stats["build_s"] + compile_s, 6)
+    stats["programs"][name] = f"rebuilt:{reason}"
+    observe.record_event(
+        "aot.build", program=name, reason=reason,
+        compile_s=round(compile_s, 6),
+    )
+    return True
+
+
+def warm_boot(programs: Iterable[Tuple[str, Any, tuple]], aot_cfg,
+              store: Optional[ExecutableStore] = None,
+              clock=time.perf_counter) -> Dict[str, Any]:
+    """Eagerly install executables for every (name, timer, abstract_args)
+    triple (the `trace_entrypoints()` shape). Bucket variants sharing one
+    timer share one dispatcher. Returns the boot stats dict and emits the
+    `aot.boot` summary event; raises `AotBootError` on any miss when
+    aot_cfg.mode == "strict"."""
+    from dorpatch_tpu.observe.events import _FirstCallTimer
+
+    mode = getattr(aot_cfg, "mode", "auto")
+    if store is None:
+        store = ExecutableStore(getattr(aot_cfg, "cache_dir", ""))
+    t0_boot = clock()
+    stats: Dict[str, Any] = {
+        "mode": mode, "store": store.store_dir, "hits": 0, "misses": 0,
+        "builds": 0, "load_s": 0.0, "build_s": 0.0, "saved_s": 0.0,
+        "miss_reasons": {}, "programs": {},
+    }
+    groups: Dict[int, Tuple[Any, List[Tuple[str, tuple]]]] = {}
+    order: List[int] = []
+    for name, fn, args in programs:
+        key = id(fn)
+        if key not in groups:
+            groups[key] = (fn, [])
+            order.append(key)
+        groups[key][1].append((name, tuple(args)))
+    dirty = False
+    try:
+        for key in order:
+            timer, items = groups[key]
+            if not isinstance(timer, _FirstCallTimer):
+                for name, _ in items:
+                    _note_miss(stats, name, "unsupported", mode)
+                continue
+            fallback = timer.__wrapped__
+            if isinstance(fallback, AotDispatcher):
+                fallback = fallback.fallback
+            if not hasattr(fallback, "trace"):
+                for name, _ in items:
+                    _note_miss(stats, name, "unsupported", mode)
+                continue
+            disp = AotDispatcher(fallback, timer._name, store=store)
+            for name, args in items:
+                dirty |= _boot_one(
+                    store, disp, fallback, name, args, mode, stats, clock
+                )
+            timer.__wrapped__ = disp
+    finally:
+        if dirty:
+            store.save()
+    stats["boot_s"] = round(clock() - t0_boot, 3)
+    observe.record_event(
+        "aot.boot", mode=mode, hits=stats["hits"], misses=stats["misses"],
+        builds=stats["builds"], boot_s=stats["boot_s"],
+        saved_s=round(stats["saved_s"], 3),
+    )
+    return stats
+
+
+class FirstCallAotResolver:
+    """`observe.set_aot_resolver` hook for farm workers: on a timer's first
+    call, substitute a read-only store-backed dispatcher so a reclaimed
+    job's resume does not re-pay compile. Never writes the store and never
+    raises into the host — any internal failure means "no substitution"."""
+
+    def __init__(self, store: ExecutableStore):
+        self.store = store
+        self.stats: Dict[str, Any] = {
+            "store": store.store_dir, "hits": 0, "misses": 0,
+            "load_s": 0.0, "programs": {},
+        }
+
+    def before_first_call(self, name: str, wrapped, args, kwargs):
+        try:
+            if isinstance(wrapped, AotDispatcher) or not hasattr(
+                wrapped, "trace"
+            ):
+                return None
+            return AotDispatcher(
+                wrapped, name, store=self.store, stats=self.stats
+            )
+        except Exception:
+            return None
+
+
+__all__ = [
+    "AotBootError",
+    "AotDispatcher",
+    "FirstCallAotResolver",
+    "call_signature",
+    "warm_boot",
+]

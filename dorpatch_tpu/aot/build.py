@@ -1,0 +1,239 @@
+"""Build/verify/ls/gc logic behind `python -m dorpatch_tpu.aot`.
+
+`build` is the deploy-time half of the warm-boot story: enumerate
+`production_entrypoints()`, gate on the PR 8 baseline check (a store built
+from a drifted tree must not exist — the refusal happens before any compile),
+then AOT-compile and serialize every program into the store. Entries whose
+backend refuses executable serialization fall back to the XLA persistent
+compilation cache under the store (method "persistent_cache", recorded per
+entry, so boot knows to re-lower against the disk cache instead of
+deserializing).
+
+Exit codes mirror `dorpatch_tpu.analysis`: 0 clean, 1 findings/refusal,
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import sys
+import time
+from typing import List, Optional
+
+from dorpatch_tpu.aot.store import ExecutableStore
+
+
+def _err(msg: str) -> None:
+    sys.stderr.write(msg + "\n")
+
+
+def _load_baseline(baseline_file: str):
+    from dorpatch_tpu.analysis import baseline as baseline_mod
+
+    path = baseline_file or baseline_mod.baseline_path()
+    data = baseline_mod.load_baseline(path)
+    if data is None:
+        _err(
+            f"aot: cannot read baseline file {path!r} — run "
+            "`python -m dorpatch_tpu.analysis --baseline update` first"
+        )
+    return path, data
+
+
+def build_store(store_dir: str, baseline_file: str = "",
+                only: Optional[List[str]] = None, fmt: str = "human",
+                entrypoints_spec: str = "") -> int:
+    """Compile+serialize every production program into ``store_dir``."""
+    from dorpatch_tpu.analysis import baseline as baseline_mod
+    from dorpatch_tpu.analysis.cli import _load_entrypoints, emit
+
+    path, data = _load_baseline(baseline_file)
+    if data is None:
+        return 2
+    loaded = _load_entrypoints(entrypoints_spec)
+    if loaded is None:
+        return 2
+    eps, budgets, ladders, _uncovered = loaded
+    # Refusal gate: the live tree must match the baseline before a single
+    # executable is written (estimate-cost mode — fingerprints and
+    # interfaces are exact there; compiled cost deltas are not the build
+    # gate's business).
+    findings = baseline_mod.check_entrypoints(
+        eps, data, budgets, ladders, compiled=False
+    )
+    if findings:
+        emit(findings, fmt)
+        _err(
+            f"aot build REFUSED: --baseline check failed with "
+            f"{len(findings)} finding(s) against {path}; nothing written. "
+            "Fix the drift or regenerate the baseline, then rebuild."
+        )
+        return 1
+
+    store = ExecutableStore(store_dir, check_env=False)
+    store.manifest = {"version": 1, "env": None, "entries": {}}
+    store.env_reason = None
+    built, skipped = 0, []
+    for ep in eps:
+        if only and not any(fnmatch.fnmatch(ep.name, g) for g in only):
+            continue
+        fn = ep.fn
+        if not hasattr(fn, "trace"):
+            skipped.append(ep.name)
+            continue
+        traced = fn.trace(*ep.args, **ep.kwargs)
+        fp = baseline_mod.fingerprint(traced.jaxpr)
+        from dorpatch_tpu.aot.boot import (
+            _interface_sha,
+            _serialize_payload,
+        )
+
+        iface = _interface_sha(traced)
+        t0 = time.perf_counter()
+        compiled = traced.lower().compile()
+        compile_s = time.perf_counter() - t0
+        method, payload = _serialize_payload(compiled)
+        if method == "persistent_cache":
+            from dorpatch_tpu import utils
+
+            utils.enable_compilation_cache(store.xla_cache_dir)
+            traced.lower().compile()
+        store.put(ep.name, fp, iface, method, payload, compile_s)
+        built += 1
+    store.stamp_baseline(
+        baseline_mod.fingerprint_set_hash(data.get("entries", {})), path
+    )
+    store.save()
+    _err(
+        f"aot build: {built} executable(s) -> {store.store_dir} "
+        f"(state {store.state_hash()})"
+        + (f"; skipped non-jit: {', '.join(skipped)}" if skipped else "")
+    )
+    return 0
+
+
+def verify_store(store_dir: str, baseline_file: str = "",
+                 fmt: str = "human") -> int:
+    path, data = _load_baseline(baseline_file)
+    if data is None:
+        return 2
+    from dorpatch_tpu.analysis.cli import emit
+
+    store = ExecutableStore(store_dir, check_env=False)
+    findings = store.verify_against(data)
+    if findings:
+        emit(findings, fmt)
+        _err(f"aot verify: {len(findings)} DP305 finding(s) against {path}")
+        return 1
+    _err(
+        f"aot verify: OK — {len(store.entries())} entr(ies) consistent "
+        f"with {path} (state {store.state_hash()})"
+    )
+    return 0
+
+
+def ls_store(store_dir: str, as_json: bool = False) -> int:
+    import json
+
+    store = ExecutableStore(store_dir, check_env=False)
+    if as_json:
+        sys.stdout.write(
+            json.dumps(
+                {
+                    "store": store.store_dir,
+                    "state": store.state_hash(),
+                    "env": store.manifest.get("env"),
+                    "entries": store.entries(),
+                },
+                indent=2,
+                sort_keys=True,
+            )
+            + "\n"
+        )
+        return 0
+    env = store.manifest.get("env") or {}
+    sys.stdout.write(
+        f"store {store.store_dir}  state {store.state_hash()}  "
+        f"env jax={env.get('jax')} backend={env.get('backend')} "
+        f"topology={env.get('topology')}\n"
+    )
+    for name, e in sorted(store.entries().items()):
+        sys.stdout.write(
+            f"  {name}  fp={e.get('fingerprint')}  "
+            f"iface={e.get('interface_sha')}  method={e.get('method')}  "
+            f"{e.get('payload_bytes', 0)}B  "
+            f"compile={e.get('build_compile_s', 0.0):.3f}s\n"
+        )
+    return 0
+
+
+def gc_store(store_dir: str, baseline_file: str = "") -> int:
+    path, data = _load_baseline(baseline_file)
+    if data is None:
+        return 2
+    store = ExecutableStore(store_dir, check_env=False)
+    removed = store.gc(data.get("entries", {}))
+    store.save()
+    _err(
+        f"aot gc: removed {len(removed)} entr(ies) whose fingerprint left "
+        f"{path}" + (f": {', '.join(removed)}" if removed else "")
+    )
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m dorpatch_tpu.aot",
+        description=(
+            "AOT executable store: build at deploy time, warm-boot at "
+            "serve time (see README 'AOT executable store')."
+        ),
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--store", required=True,
+                       help="store directory (manifest.json + blobs/)")
+
+    pb = sub.add_parser("build", help="compile+serialize every production "
+                        "program (refuses on baseline drift)")
+    common(pb)
+    pb.add_argument("--baseline-file", default="",
+                    help="override analysis/baselines.json")
+    pb.add_argument("--only", action="append", default=[],
+                    help="fnmatch glob over entry names (repeatable)")
+    pb.add_argument("--entrypoints", default="",
+                    help="module:callable override for the registry loader")
+    pb.add_argument("--format", choices=("human", "json"), default="human")
+
+    pv = sub.add_parser("verify", help="DP305 drift check: store manifest "
+                        "vs analysis/baselines.json")
+    common(pv)
+    pv.add_argument("--baseline-file", default="")
+    pv.add_argument("--format", choices=("human", "json"), default="human")
+
+    pl = sub.add_parser("ls", help="list store entries")
+    common(pl)
+    pl.add_argument("--json", action="store_true")
+
+    pg = sub.add_parser("gc", help="drop entries whose fingerprint left "
+                        "baselines.json")
+    common(pg)
+    pg.add_argument("--baseline-file", default="")
+
+    args = parser.parse_args(argv)
+    if args.cmd == "build":
+        return build_store(args.store, args.baseline_file, args.only,
+                           args.format, args.entrypoints)
+    if args.cmd == "verify":
+        return verify_store(args.store, args.baseline_file, args.format)
+    if args.cmd == "ls":
+        return ls_store(args.store, args.json)
+    if args.cmd == "gc":
+        return gc_store(args.store, args.baseline_file)
+    return 2
+
+
+__all__ = ["build_store", "verify_store", "ls_store", "gc_store", "main"]

@@ -1,0 +1,407 @@
+"""Content-addressed on-disk store for AOT-compiled executables.
+
+Layout under ``store_dir``:
+
+    manifest.json          deterministic index (atomic tmp+replace writes)
+    blobs/<name>-<sha>.bin serialized executables (sha256-verified on read)
+    xla_cache/             XLA persistent compilation cache, used for entries
+                           whose backend refused `serialize_executable`
+                           (method "persistent_cache")
+
+An entry's key is the PR 8 baseline record — the program's canonical-jaxpr
+body fingerprint plus its interface hash — combined with the environment the
+store was built under (jax version, backend, device-topology hash). A lookup
+misses with a typed reason rather than ever returning bytes that could
+install a stale or foreign executable: ``absent``, ``fingerprint``,
+``interface``, ``topology``, ``corrupt``.
+
+The manifest is read tolerantly: a torn or garbage file behaves as an empty
+store (every lookup misses ``absent``), mirroring `checkpoint.load_json` —
+boot falls back to compiling, never crashes on bad persisted state.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from dorpatch_tpu.checkpoint import atomic_write_json, load_json
+
+MANIFEST = "manifest.json"
+STORE_VERSION = 1
+
+# env keys that must match for any entry in the store to be servable; a
+# mismatch in any of them is reported as the single miss reason "topology"
+# (the executable was compiled for a different world, whichever axis moved).
+_ENV_KEYS = ("jax", "backend", "topology")
+
+
+def topology_hash() -> str:
+    """16-hex digest over the visible device mesh: platform/device-kind per
+    device plus device and process counts. Two hosts with the same digest can
+    exchange serialized executables; anything else must recompile."""
+    import jax
+
+    devs = sorted(
+        (d.platform, str(getattr(d, "device_kind", ""))) for d in jax.devices()
+    )
+    txt = "|".join(
+        [str(jax.device_count()), str(jax.process_count())]
+        + [f"{p}:{k}" for p, k in devs]
+    )
+    return hashlib.sha256(txt.encode()).hexdigest()[:16]
+
+
+def current_env() -> Dict[str, Any]:
+    import jax
+
+    return {
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "topology": topology_hash(),
+        "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
+    }
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()[:16]
+
+
+def _slug(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]", "_", name)
+
+
+class ExecutableStore:
+    """Open (or create) an executable store rooted at ``store_dir``.
+
+    ``check_env=True`` compares the manifest's recorded build environment
+    against the live one at open; on mismatch every lookup misses with
+    reason ``topology`` until the first `put` resets the store to the live
+    environment (a rewrite under a new topology starts from a clean slate —
+    entries built for the old mesh are unservable here by definition).
+    """
+
+    def __init__(self, store_dir: str, check_env: bool = True):
+        self.store_dir = os.path.abspath(store_dir)
+        self.blob_dir = os.path.join(self.store_dir, "blobs")
+        self.xla_cache_dir = os.path.join(self.store_dir, "xla_cache")
+        self.manifest_path = os.path.join(self.store_dir, MANIFEST)
+        raw = load_json(self.manifest_path, default=None)
+        if not isinstance(raw, dict) or not isinstance(
+            raw.get("entries"), dict
+        ):
+            raw = {"version": STORE_VERSION, "env": None, "entries": {}}
+        self.manifest: Dict[str, Any] = raw
+        self.env_reason: Optional[str] = None
+        if check_env and self.manifest.get("entries"):
+            env = self.manifest.get("env") or {}
+            live = current_env()
+            if any(env.get(k) != live.get(k) for k in _ENV_KEYS):
+                self.env_reason = "topology"
+
+    # ------------------------------------------------------------- entries
+
+    def entries(self) -> Dict[str, Dict[str, Any]]:
+        return self.manifest.get("entries", {})
+
+    def _blob_path(self, entry: Dict[str, Any]) -> str:
+        return os.path.join(self.store_dir, entry.get("payload", ""))
+
+    def _read_payload(
+        self, entry: Dict[str, Any]
+    ) -> Tuple[Optional[bytes], Optional[str]]:
+        if entry.get("method") == "persistent_cache":
+            # no blob: the executable lives in xla_cache/, re-materialized by
+            # an AOT compile with the persistent cache enabled (still zero
+            # traces on the jit's own cache).
+            return b"", None
+        try:
+            with open(self._blob_path(entry), "rb") as fh:
+                payload = fh.read()
+        except OSError:
+            return None, "corrupt"
+        if _sha(payload) != entry.get("payload_sha"):
+            return None, "corrupt"
+        return payload, None
+
+    def lookup(
+        self, name: str, fingerprint: str, interface_sha: str = ""
+    ) -> Tuple[Optional[bytes], Optional[Dict[str, Any]], Optional[str]]:
+        """Return (payload, entry, miss_reason); miss_reason None on hit."""
+        if self.env_reason:
+            return None, None, self.env_reason
+        entry = self.entries().get(name)
+        if entry is None:
+            return None, None, "absent"
+        if entry.get("fingerprint") != fingerprint:
+            return None, entry, "fingerprint"
+        if (
+            interface_sha
+            and entry.get("interface_sha")
+            and entry.get("interface_sha") != interface_sha
+        ):
+            return None, entry, "interface"
+        payload, reason = self._read_payload(entry)
+        if reason is not None:
+            return None, entry, reason
+        return payload, entry, None
+
+    def lookup_by_fingerprint(
+        self, fingerprint: str, interface_sha: str = ""
+    ) -> Tuple[Optional[bytes], Optional[Dict[str, Any]], Optional[str]]:
+        """Content-addressed lookup ignoring the entry name — used by the
+        farm's lazy first-call resolver, where the live timer name need not
+        match the entrypoint-registry name the store was built under."""
+        if self.env_reason:
+            return None, None, self.env_reason
+        for name in sorted(self.entries()):
+            entry = self.entries()[name]
+            if entry.get("fingerprint") != fingerprint:
+                continue
+            if (
+                interface_sha
+                and entry.get("interface_sha")
+                and entry.get("interface_sha") != interface_sha
+            ):
+                continue
+            payload, reason = self._read_payload(entry)
+            if reason is None:
+                return payload, entry, None
+        return None, None, "absent"
+
+    def put(
+        self,
+        name: str,
+        fingerprint: str,
+        interface_sha: str,
+        method: str,
+        payload: bytes,
+        build_compile_s: float,
+    ) -> Dict[str, Any]:
+        """Write/overwrite one entry (blob immediately, manifest on save())."""
+        if self.env_reason:
+            # rebuilding under a different environment: the old entries are
+            # unservable here, so start clean under the live env.
+            self.manifest = {
+                "version": STORE_VERSION,
+                "env": None,
+                "entries": {},
+            }
+            self.env_reason = None
+        os.makedirs(self.blob_dir, exist_ok=True)
+        entry: Dict[str, Any] = {
+            "fingerprint": fingerprint,
+            "interface_sha": interface_sha,
+            "method": method,
+            "payload": "",
+            "payload_sha": "",
+            "payload_bytes": 0,
+            "build_compile_s": round(float(build_compile_s), 6),
+        }
+        if method != "persistent_cache":
+            rel = os.path.join(
+                "blobs", f"{_slug(name)}-{_sha(name.encode())[:10]}.bin"
+            )
+            path = os.path.join(self.store_dir, rel)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(payload)
+            os.replace(tmp, path)
+            entry.update(
+                payload=rel,
+                payload_sha=_sha(payload),
+                payload_bytes=len(payload),
+            )
+        self.manifest.setdefault("entries", {})[name] = entry
+        return entry
+
+    def remove(self, name: str) -> bool:
+        entry = self.entries().pop(name, None)
+        if entry is None:
+            return False
+        if entry.get("payload"):
+            try:
+                os.remove(self._blob_path(entry))
+            except OSError:
+                pass
+        return True
+
+    def save(self) -> None:
+        """Atomically persist the manifest, stamping the live environment."""
+        os.makedirs(self.store_dir, exist_ok=True)
+        if self.manifest.get("env") is None:
+            self.manifest["env"] = current_env()
+        self.manifest["version"] = STORE_VERSION
+        self.manifest["entries"] = {
+            k: self.manifest["entries"][k]
+            for k in sorted(self.manifest.get("entries", {}))
+        }
+        atomic_write_json(self.manifest_path, self.manifest)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def state_hash(self) -> str:
+        """16-hex digest over (name, fingerprint, payload_sha) triples — the
+        store identity BENCH boot rows stamp next to the program_set hash."""
+        lines = [
+            f"{n}:{e.get('fingerprint')}:{e.get('payload_sha')}"
+            for n, e in sorted(self.entries().items())
+        ]
+        return hashlib.sha256("\n".join(lines).encode()).hexdigest()[:16]
+
+    def gc(self, baseline_entries: Dict[str, Any]) -> List[str]:
+        """Drop entries whose name or fingerprint has left baselines.json,
+        plus orphaned blobs; returns the removed entry names."""
+        removed = []
+        for name in sorted(self.entries()):
+            entry = self.entries()[name]
+            base = baseline_entries.get(name)
+            if base is None or base.get("fingerprint") != entry.get(
+                "fingerprint"
+            ):
+                self.remove(name)
+                removed.append(name)
+        live_blobs = {
+            e.get("payload")
+            for e in self.entries().values()
+            if e.get("payload")
+        }
+        if os.path.isdir(self.blob_dir):
+            for fn in os.listdir(self.blob_dir):
+                rel = os.path.join("blobs", fn)
+                if rel not in live_blobs and fn.endswith(".bin"):
+                    try:
+                        os.remove(os.path.join(self.blob_dir, fn))
+                    except OSError:
+                        pass
+        return removed
+
+    def stamp_baseline(self, fingerprint_set: str, path) -> None:
+        self.manifest["baseline"] = {
+            "fingerprint_set": fingerprint_set,
+            "file": str(path),  # may arrive as a pathlib.Path
+        }
+
+    # -------------------------------------------------------------- verify
+
+    def verify_against(
+        self,
+        baseline_data: Dict[str, Any],
+        allow: Optional[Dict[str, Dict[str, str]]] = None,
+        check_env: bool = True,
+    ) -> List[Any]:
+        """DP305 findings wherever the store disagrees with baselines.json:
+        stale entries (fingerprint/interface drift or no longer baselined),
+        missing entries, corrupt blobs, and a build-env/topology mismatch
+        against the live process. Suppression goes through the same
+        allowlist channel as the other DP3xx rules (`# noqa` has no source
+        line to live on inside manifest.json, so the allowlist is the
+        sanctioned override — see README)."""
+        from dorpatch_tpu.analysis import baseline as baseline_mod
+        from dorpatch_tpu.analysis.engine import Finding
+
+        findings: List[Any] = []
+
+        def add(name: str, msg: str) -> None:
+            if baseline_mod.allowed(name, "DP305", allow):
+                return
+            findings.append(
+                Finding(
+                    path=self.manifest_path,
+                    line=1,
+                    col=0,
+                    rule_id="DP305",
+                    message=f"[{name}] {msg}",
+                )
+            )
+
+        base_entries = baseline_data.get("entries", {})
+        if check_env and self.entries():
+            env = self.manifest.get("env") or {}
+            live = current_env()
+            bad = [k for k in _ENV_KEYS if env.get(k) != live.get(k)]
+            if bad:
+                add(
+                    "<store>",
+                    "store built under a different environment ("
+                    + ", ".join(
+                        f"{k}: {env.get(k)!r} != {live.get(k)!r}" for k in bad
+                    )
+                    + ") — its executables cannot serve here; rebuild with "
+                    "`python -m dorpatch_tpu.aot build`",
+                )
+        for name in sorted(self.entries()):
+            entry = self.entries()[name]
+            base = base_entries.get(name)
+            if base is None:
+                add(
+                    name,
+                    "store entry is no longer in baselines.json — stale; "
+                    "run `python -m dorpatch_tpu.aot gc`",
+                )
+                continue
+            if base.get("fingerprint") != entry.get("fingerprint"):
+                add(
+                    name,
+                    "store fingerprint "
+                    f"{entry.get('fingerprint')} != baseline "
+                    f"{base.get('fingerprint')} — stale executable; rebuild",
+                )
+            base_iface = (base.get("interface") or {}).get("sha")
+            if (
+                base_iface
+                and entry.get("interface_sha")
+                and base_iface != entry.get("interface_sha")
+            ):
+                add(
+                    name,
+                    "store interface hash disagrees with baseline "
+                    "(DP304-style drift) — rebuild",
+                )
+            _, reason = self._read_payload(entry)
+            if reason is not None:
+                add(name, f"executable payload unreadable ({reason}) — rebuild")
+        for name in sorted(base_entries):
+            if name not in self.entries():
+                add(
+                    name,
+                    "baselined program has no store entry — warm boot will "
+                    "miss; run `python -m dorpatch_tpu.aot build`",
+                )
+        return findings
+
+    def select(self, globs) -> List[str]:
+        names = sorted(self.entries())
+        if not globs:
+            return names
+        return [
+            n for n in names if any(fnmatch.fnmatch(n, g) for g in globs)
+        ]
+
+
+def open_readonly(store_dir: str) -> Optional[ExecutableStore]:
+    """Best-effort open for boot paths that must never fail: returns None on
+    any error (missing dir, unreadable manifest handled inside; this guards
+    the truly unexpected)."""
+    try:
+        return ExecutableStore(store_dir)
+    except Exception:
+        return None
+
+
+def find_manifest(store_dir: str) -> str:
+    return os.path.join(os.path.abspath(store_dir), MANIFEST)
+
+
+__all__ = [
+    "ExecutableStore",
+    "current_env",
+    "topology_hash",
+    "open_readonly",
+    "find_manifest",
+    "MANIFEST",
+]

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import argparse
 
-from dorpatch_tpu.config import (AttackConfig, DefenseConfig,
+from dorpatch_tpu.config import (AotConfig, AttackConfig, DefenseConfig,
                                  ExperimentConfig, FarmConfig, ServeConfig)
 
 
@@ -157,6 +157,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-deadline-ms", type=float, default=2000.0,
                    help="default per-request latency budget; the batcher "
                         "flushes a partial batch once half of it is spent")
+    # AOT executable store (`python -m dorpatch_tpu.aot build` writes it;
+    # serve/farm warm-boot from it — README "AOT executable store")
+    p.add_argument("--aot-cache", default="",
+                   help="AOT executable store directory: serve boots by "
+                        "deserializing pre-compiled executables keyed by "
+                        "the baseline fingerprints instead of tracing "
+                        "('' = disabled)")
+    p.add_argument("--aot", default="off",
+                   choices=["off", "auto", "strict"],
+                   help="warm-boot mode: 'auto' compiles-and-rewrites the "
+                        "store on any miss (fingerprint/topology drift, "
+                        "corrupt blob — never serves stale); 'strict' is "
+                        "the deploy mode, failing boot on any miss so a "
+                        "fleet restart either comes up warm with zero "
+                        "traces or visibly refuses")
     # farm (`python -m dorpatch_tpu.farm` shares these defaults; setting
     # them here persists them into the config record a spec's `base` carries)
     p.add_argument("--farm-lease-ttl", type=float, default=60.0,
@@ -249,6 +264,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
                         max_attempts=args.farm_max_attempts,
                         backoff_base=args.farm_backoff_base,
                         chaos=args.chaos),
+        aot=AotConfig(cache_dir=args.aot_cache, mode=args.aot),
     )
 
 

@@ -213,6 +213,24 @@ class FarmConfig:
                                     # wedge_heartbeat, enospc_events
 
 
+@dataclasses.dataclass(frozen=True)
+class AotConfig:
+    """AOT executable store (`dorpatch_tpu/aot/`): warm-boot serving from
+    pre-compiled executables keyed by the baseline fingerprints.
+
+    `mode` semantics:
+      "off"    — (default) boot compiles in process, store untouched.
+      "auto"   — load what hits; any miss (absent entry, fingerprint/
+                 interface drift, topology change, corrupt blob) compiles
+                 AND rewrites the store entry — never serves stale.
+      "strict" — the deploy mode: any miss fails boot (`AotBootError`)
+                 instead of compiling, so a fleet restart either comes up
+                 warm or visibly refuses."""
+
+    cache_dir: str = ""             # store directory ("" = AOT disabled)
+    mode: str = "off"               # off|auto|strict
+
+
 def config_to_dict(cfg: "ExperimentConfig") -> dict:
     """JSON-safe nested dict of the full experiment config (reproducibility
     record written beside summary.json by the pipelines)."""
@@ -240,9 +258,10 @@ def config_from_dict(d: dict) -> "ExperimentConfig":
     defense = build(DefenseConfig, d.pop("defense", {}))
     serve = build(ServeConfig, d.pop("serve", {}))
     farm = build(FarmConfig, d.pop("farm", {}))
+    aot = build(AotConfig, d.pop("aot", {}))
     cfg = build(ExperimentConfig, d)
     return dataclasses.replace(cfg, attack=attack, defense=defense,
-                               serve=serve, farm=farm)
+                               serve=serve, farm=farm, aot=aot)
 
 
 def resolved_data_source(cfg: "ExperimentConfig") -> str:
@@ -321,6 +340,7 @@ class ExperimentConfig:
     defense: DefenseConfig = dataclasses.field(default_factory=DefenseConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     farm: FarmConfig = dataclasses.field(default_factory=FarmConfig)
+    aot: AotConfig = dataclasses.field(default_factory=AotConfig)
 
     @property
     def num_classes(self) -> int:

@@ -55,6 +55,16 @@ def build_parser() -> argparse.ArgumentParser:
                     default="kill",
                     help="crash_block dies by SIGKILL (kill) or by a "
                          "catchable SimulatedPreemption (raise)")
+    pw.add_argument("--aot-store", default="",
+                    help="shared AOT executable store (read-only): jitted "
+                         "programs warm-boot from pre-compiled executables "
+                         "on first call instead of tracing, so reclaimed "
+                         "jobs resume without re-paying compile "
+                         "('' = disabled)")
+    pw.add_argument("--aot", choices=["off", "auto"], default="auto",
+                    help="warm-boot mode with --aot-store (workers never "
+                         "write the store; 'auto' here means "
+                         "load-what-hits, compile the rest)")
 
     pst = sub.add_parser("status", help="queue counts as one JSON line")
     pst.add_argument("farm_dir")
@@ -83,7 +93,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             heartbeat_interval=args.heartbeat_interval,
             backoff_base=args.backoff_base, backoff_cap=args.backoff_cap,
             backoff_jitter=args.backoff_jitter, chaos=args.chaos,
-            crash_mode=args.crash_mode)
+            crash_mode=args.crash_mode,
+            aot_store=args.aot_store, aot_mode=args.aot)
         summary = worker.run(max_jobs=args.max_jobs)
         observe.log(json.dumps(summary))
         return 0

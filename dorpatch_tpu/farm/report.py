@@ -135,6 +135,19 @@ def summarize_fleet(farm_dir: str) -> Optional[dict]:
                 1 for r in rows if "resumed_from_iteration" in r),
             **step_time,
         })
+    # per-worker AOT warm-boot accounting (workers/<id>/aot.json, written
+    # by FarmWorker.run when booting against a shared executable store)
+    aot_by_worker: Dict[str, dict] = {}
+    workers_dir = os.path.join(farm_dir, "workers")
+    if os.path.isdir(workers_dir):
+        for wid in sorted(os.listdir(workers_dir)):
+            rec = load_json(os.path.join(workers_dir, wid, "aot.json"))
+            if isinstance(rec, dict):
+                aot_by_worker[wid] = {
+                    "hits": int(rec.get("hits", 0)),
+                    "misses": int(rec.get("misses", 0)),
+                    "load_s": float(rec.get("load_s", 0.0)),
+                }
     return {
         "farm_dir": os.path.abspath(farm_dir),
         "spec_jobs": int(farm.get("jobs", 0)),
@@ -147,6 +160,7 @@ def summarize_fleet(farm_dir: str) -> Optional[dict]:
         "step_time": {"useful_s": round(useful_s, 3),
                       "wasted_s": round(wasted_s, 3),
                       "reexecuted_blocks": reexecuted_blocks},
+        "aot_by_worker": aot_by_worker,
         "points": points,
         "jobs": jobs,
     }
@@ -180,6 +194,10 @@ def format_fleet_report(s: dict) -> str:
     add(f"  step time: {st['useful_s']:.3f}s useful, "
         f"{st['wasted_s']:.3f}s re-executed ({pct:.1f}% waste, "
         f"{st['reexecuted_blocks']} re-run block(s))")
+    if s.get("aot_by_worker"):
+        add("  aot warm boot: " + ", ".join(
+            f"{w}: {a['hits']} hit(s)/{a['misses']} miss(es)"
+            for w, a in sorted(s["aot_by_worker"].items())))
     for q in s["quarantined"]:
         add(f"  quarantined {q['id']}: [{q['kind']}] {q['error'][:90]}")
     add("-- jobs --")

@@ -32,7 +32,7 @@ import time
 import traceback
 from typing import Callable, Dict, Optional, Tuple
 
-from dorpatch_tpu import observe
+from dorpatch_tpu import checkpoint, observe
 from dorpatch_tpu.config import ExperimentConfig, config_from_dict
 from dorpatch_tpu.farm import queue as farm_queue
 from dorpatch_tpu.farm.chaos import Chaos, SimulatedPreemption, parse_faults
@@ -147,7 +147,8 @@ class FarmWorker:
                  heartbeat_interval: float = 1.0, chaos: str = "",
                  crash_mode: str = "kill",
                  runner: Optional[Callable[[Dict, JobContext], Dict]] = None,
-                 clock=time.time, sleep=time.sleep):
+                 clock=time.time, sleep=time.sleep,
+                 aot_store: str = "", aot_mode: str = "auto"):
         self.queue = farm_queue.JobQueue(farm_dir, clock=clock)
         self.worker_id = worker_id or f"w{os.getpid()}"
         self.lease_ttl = float(lease_ttl)
@@ -161,6 +162,14 @@ class FarmWorker:
         self.runner = runner if runner is not None else default_runner
         self._clock = clock
         self._sleep = sleep
+        # AOT executable store (shared, opened READ-ONLY): jitted programs
+        # whose fingerprint matches a store entry boot from pre-compiled
+        # executables on their first call, so a reclaimed job's resume does
+        # not re-pay compile. Read-only by design — N workers racing writes
+        # into one store is the failure mode the build subcommand exists to
+        # avoid.
+        self.aot_store = aot_store
+        self.aot_mode = aot_mode
         self.worker_dir = os.path.join(self.queue.farm_dir, "workers",
                                        self.worker_id)
         self.heartbeat_path = os.path.join(self.worker_dir,
@@ -177,6 +186,23 @@ class FarmWorker:
         os.makedirs(self.worker_dir, exist_ok=True)
         summary = {"worker": self.worker_id, "done": 0, "failed": 0,
                    "quarantined": 0, "abandoned": 0}
+        resolver = None
+        prev_resolver = None
+        if self.aot_store and self.aot_mode != "off":
+            # install BEFORE claiming anything: the first jitted call of the
+            # first job is already warm-boot eligible
+            try:
+                from dorpatch_tpu.aot.boot import FirstCallAotResolver
+                from dorpatch_tpu.aot.store import open_readonly
+
+                store = open_readonly(self.aot_store)
+                if store is not None:
+                    resolver = FirstCallAotResolver(store)
+            except Exception:
+                resolver = None  # warm boot is an optimization, never a gate
+            if resolver is not None:
+                prev_resolver = observe.aot_resolver()
+                observe.set_aot_resolver(resolver)
         heartbeat = observe.Heartbeat(
             self.heartbeat_path, get_phase=lambda: self._phase,
             interval=self.heartbeat_interval, clock=self._clock)
@@ -208,6 +234,13 @@ class FarmWorker:
                         break
             finally:
                 self._heartbeat = None
+                if resolver is not None:
+                    observe.set_aot_resolver(prev_resolver)
+                    summary["aot"] = dict(resolver.stats)
+                    # per-worker hit counts for the fleet report
+                    checkpoint.atomic_write_json(
+                        os.path.join(self.worker_dir, "aot.json"),
+                        {"worker": self.worker_id, **resolver.stats})
         summary["counts"] = self.queue.counts()
         return summary
 

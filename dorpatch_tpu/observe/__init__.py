@@ -39,12 +39,14 @@ from dorpatch_tpu.observe.events import (  # noqa: F401
     EventLog,
     active,
     active_event_log,
+    aot_resolver,
     device_memory_stats,
     entrypoint_recorder,
     events_filename,
     record_compile,
     record_event,
     recompile_guard,
+    set_aot_resolver,
     set_entrypoint_recorder,
     set_recompile_guard,
     span,
@@ -80,6 +82,7 @@ __all__ = [
     "Watchdog",
     "active",
     "active_event_log",
+    "aot_resolver",
     "device_memory_stats",
     "elapsed",
     "entrypoint_recorder",
@@ -97,6 +100,7 @@ __all__ = [
     "record_event",
     "recompile_guard",
     "run_manifest",
+    "set_aot_resolver",
     "set_entrypoint_recorder",
     "set_process_index",
     "set_recompile_guard",

@@ -292,6 +292,27 @@ def entrypoint_recorder():
     return _ENTRYPOINT_RECORDER
 
 
+# ---------------- aot warm-boot resolver hook (dorpatch_tpu/aot) -------------
+
+# Installed by the AOT warm-boot layer (`dorpatch_tpu.aot.boot`): an object
+# whose `before_first_call(name, wrapped, args, kwargs)` fires exactly once,
+# on a timer's FIRST invocation, and may return a replacement callable (a
+# store-backed dispatcher serving a pre-compiled executable) to install as
+# `__wrapped__` before the timed call runs. Returning None keeps the original
+# program. Lives here so observe never imports the aot package; None means
+# no warm boot.
+_AOT_RESOLVER = None
+
+
+def set_aot_resolver(resolver) -> None:
+    global _AOT_RESOLVER
+    _AOT_RESOLVER = resolver
+
+
+def aot_resolver():
+    return _AOT_RESOLVER
+
+
 class _FirstCallTimer:
     """Callable proxy recording the wrapped fn's first-call wall time as a
     `compile` event. Unknown attributes delegate to the wrapped callable, so
@@ -316,6 +337,15 @@ class _FirstCallTimer:
             out = self.__wrapped__(*args, **kwargs)
         else:
             self._done = True
+            resolver = _AOT_RESOLVER
+            if resolver is not None:
+                # warm boot: swap in a pre-compiled executable before the
+                # first (otherwise compiling) dispatch; the resolver returns
+                # None to decline and never raises
+                replacement = resolver.before_first_call(
+                    self._name, self.__wrapped__, args, kwargs)
+                if replacement is not None:
+                    self.__wrapped__ = replacement
             t0 = self._clock()
             out = self.__wrapped__(*args, **kwargs)
             record_compile(self._name, self._clock() - t0)

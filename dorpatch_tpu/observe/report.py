@@ -228,6 +228,7 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
         },
         "mfu": mfu,
         "serve": serve,
+        "aot": _summarize_aot(ev),
         "baseline": baseline,
         "peak_device_bytes": peak_mem or None,
         "heartbeats": summarize_heartbeats(result_dir,
@@ -296,6 +297,46 @@ def _summarize_serve(ev: List[dict]) -> Optional[dict]:
         "certify_prune_rate": round(1.0 - fwd / fwd_exh, 4)
         if fwd and fwd_exh else None,
     }
+
+
+def _summarize_aot(ev: List[dict]) -> Optional[dict]:
+    """The AOT executable-store section: warm-boot hit rate and estimated
+    compile seconds saved — from the `aot.load` / `aot.miss` / `aot.build`
+    events the boot path emits plus the closing `aot.boot` summary event.
+    None when the run never touched a store (every pre-AOT results dir
+    renders unchanged)."""
+    loads = [r for r in ev
+             if r.get("kind") == "event" and r.get("name") == "aot.load"]
+    misses = [r for r in ev
+              if r.get("kind") == "event" and r.get("name") == "aot.miss"]
+    builds = [r for r in ev
+              if r.get("kind") == "event" and r.get("name") == "aot.build"]
+    boots = [r for r in ev
+             if r.get("kind") == "event" and r.get("name") == "aot.boot"]
+    if not (loads or misses or builds or boots):
+        return None
+    miss_reasons: Dict[str, int] = {}
+    for r in misses:
+        reason = str(r.get("reason", "?"))
+        miss_reasons[reason] = miss_reasons.get(reason, 0) + 1
+    attempts = len(loads) + len(misses)
+    out = {
+        "loads": len(loads),
+        "misses": len(misses),
+        "builds": len(builds),
+        "hit_rate": round(len(loads) / attempts, 4) if attempts else None,
+        "miss_reasons": dict(sorted(miss_reasons.items())),
+        "saved_s": round(sum(float(r.get("saved_s", 0.0)) for r in loads), 3),
+    }
+    if boots:
+        b = boots[-1]
+        out["boot"] = {"mode": b.get("mode", "?"),
+                       "hits": int(b.get("hits", 0)),
+                       "misses": int(b.get("misses", 0)),
+                       "builds": int(b.get("builds", 0)),
+                       "boot_s": round(float(b.get("boot_s", 0.0)), 3),
+                       "saved_s": round(float(b.get("saved_s", 0.0)), 3)}
+    return out
 
 
 def _load_baseline_check(result_dir: str) -> Optional[dict]:
@@ -414,6 +455,22 @@ def format_report(s: dict) -> str:
                 incr = f" ({fe} full-forward equivalents, incremental)"
             add(f"  certify forwards: "
                 f"{sv['certify_forwards_per_request']}/request{incr}{prune}")
+
+    ao = s.get("aot")
+    if ao:
+        add("-- aot --")
+        rate = (f"{100.0 * ao['hit_rate']:.1f}%"
+                if ao.get("hit_rate") is not None else "n/a")
+        add(f"  executable store: {ao['loads']} load(s), "
+            f"{ao['misses']} miss(es), {ao['builds']} build(s), "
+            f"hit rate {rate}")
+        if ao.get("miss_reasons"):
+            add("  miss reasons: " + ", ".join(
+                f"{k}: {v}" for k, v in ao["miss_reasons"].items()))
+        bo = ao.get("boot")
+        if bo:
+            add(f"  warm boot [{bo['mode']}]: {bo['boot_s']}s to ready, "
+                f"est {bo['saved_s']}s compile time saved")
 
     bl = s.get("baseline")
     if bl:

@@ -130,6 +130,7 @@ class CertifiedInferenceService:
         enforce_budgets: bool = True,
         clock=time.perf_counter,
         incremental_engine: Any = None,
+        aot_cfg: Any = None,
     ):
         self.apply_fn = apply_fn
         self.params = params
@@ -141,6 +142,10 @@ class CertifiedInferenceService:
         self.run_cfg = run_cfg
         self.enforce_budgets = enforce_budgets
         self._clock = clock
+        # AotConfig (or None): warm-boot the serving programs from the AOT
+        # executable store instead of tracing — see _start_inner
+        self.aot_cfg = aot_cfg
+        self._aot_stats: Optional[Dict[str, Any]] = None
 
         self.bucket_sizes = tuple(resolved_bucket_sizes(serve_cfg))
         n_buckets = len(self.bucket_sizes)
@@ -204,7 +209,8 @@ class CertifiedInferenceService:
                    defense_cfg=cfg.defense,
                    result_dir=result_dir if cfg.metrics_log else None,
                    run_cfg=cfg,
-                   incremental_engine=victim.incremental)
+                   incremental_engine=victim.incremental,
+                   aot_cfg=getattr(cfg, "aot", None))
 
     # ---------------- lifecycle ----------------
 
@@ -254,6 +260,20 @@ class CertifiedInferenceService:
             prev = observe.recompile_guard()
             observe.set_recompile_guard(RecompileWatchdog())
             self._stack.callback(observe.set_recompile_guard, prev)
+        if (self.aot_cfg is not None
+                and getattr(self.aot_cfg, "mode", "off") != "off"
+                and getattr(self.aot_cfg, "cache_dir", "")):
+            # AOT warm boot, deliberately AFTER the watchdog is armed and
+            # BEFORE warmup: every program's executable is deserialized
+            # from the store and installed behind its timer, so the warmup
+            # loop below runs it without tracing — the zero-trace contract
+            # is enforced by the same watchdog live traffic runs under.
+            # Misses compile-and-rewrite ("auto") or fail boot ("strict");
+            # a stale executable is never installed either way.
+            from dorpatch_tpu.aot.boot import warm_boot
+
+            self._aot_stats = warm_boot(self.trace_entrypoints(),
+                                        self.aot_cfg, clock=self._clock)
         if self.serve_cfg.warmup:
             self.warmup()
         self._started_at = self._clock()
@@ -472,6 +492,8 @@ class CertifiedInferenceService:
         s["buckets"] = list(self.bucket_sizes)
         s["trace_counts"] = self.trace_counts()
         s["warm"] = self._warm
+        if self._aot_stats is not None:
+            s["aot"] = self._aot_stats
         if self._started_at is not None:
             s["uptime_s"] = round(self._clock() - self._started_at, 3)
         return s

@@ -93,3 +93,15 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   | grep -q '"ok": true' \
   || { echo "farm smoke: crash-resume violation"; exit 1; }
 echo "farm smoke: OK"
+# Smoke: the AOT executable store — build a store from a cold serve boot,
+# then a strict warm boot must reach serving-ready with ZERO traces under
+# the armed recompile watchdog and answer with verdicts identical to the
+# cold service; a planted stale fingerprint must force exactly one
+# compile-and-rewrite, and `python -m dorpatch_tpu.aot build` must refuse
+# to write against a failing --baseline check (tools/aot_smoke.py exits
+# non-zero and lists the violations otherwise).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/aot_smoke.py \
+  | grep -q '"ok": true' \
+  || { echo "aot smoke: warm-boot/zero-trace violation"; exit 1; }
+echo "aot smoke: OK"

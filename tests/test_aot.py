@@ -1,0 +1,252 @@
+"""The AOT executable store (dorpatch_tpu/aot/): store round-trips,
+zero-trace warm boot through the dispatcher, strict vs auto miss handling,
+robustness against corrupt blobs and torn manifests, static-argnum
+dispatch, gc, the farm's lazy first-call resolver, `aot verify` (DP305),
+and the call-signature discriminator."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu import observe
+from dorpatch_tpu.aot.boot import (
+    AotBootError,
+    AotDispatcher,
+    FirstCallAotResolver,
+    call_signature,
+    warm_boot,
+)
+from dorpatch_tpu.aot.store import MANIFEST, ExecutableStore, open_readonly
+from dorpatch_tpu.config import AotConfig
+
+
+def fresh_program(budget=8):
+    """A new jitted toy program behind its own first-call timer. A fresh
+    closure per call: jax.jit shares its trace cache across wrappers of the
+    same function object, so trace-count assertions need distinct victims."""
+
+    def f(x):
+        return x * 2.0 + 1.0
+
+    return observe.timed_first_call(jax.jit(f), "aot.test.double",
+                                    recompile_budget=budget)
+
+
+def abstract_arg(shape=(4,)):
+    return jax.ShapeDtypeStruct(shape, np.dtype(np.float32))
+
+
+def boot(timer, store_dir, mode, name="aot.test.double", shape=(4,)):
+    return warm_boot([(name, timer, (abstract_arg(shape),))],
+                     AotConfig(cache_dir=store_dir, mode=mode))
+
+
+def test_build_then_hit_round_trip(tmp_path):
+    store = str(tmp_path / "store")
+    first = fresh_program()
+    stats = boot(first, store, "auto")
+    assert stats["builds"] == 1 and stats["hits"] == 0
+    assert os.path.exists(os.path.join(store, MANIFEST))
+
+    second = fresh_program()
+    stats = boot(second, store, "auto")
+    assert stats["hits"] == 1 and stats["misses"] == 0
+    assert stats["builds"] == 0
+    # the installed executable answers correctly with ZERO traces on the
+    # fallback jit — the mechanical zero-trace proof
+    out = second(jnp.arange(4, dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), [1.0, 3.0, 5.0, 7.0])
+    assert int(second.__wrapped__.fallback._cache_size()) == 0
+
+
+def test_strict_miss_on_empty_store_raises(tmp_path):
+    with pytest.raises(AotBootError):
+        boot(fresh_program(), str(tmp_path / "empty"), "strict")
+
+
+def test_strict_hit_boots_clean(tmp_path):
+    store = str(tmp_path / "store")
+    boot(fresh_program(), store, "auto")
+    timer = fresh_program()
+    stats = boot(timer, store, "strict")
+    assert stats["hits"] == 1 and stats["misses"] == 0
+    assert isinstance(timer.__wrapped__, AotDispatcher)
+
+
+def test_corrupt_blob_rebuilds_never_crashes(tmp_path):
+    store_dir = str(tmp_path / "store")
+    boot(fresh_program(), store_dir, "auto")
+    manifest = json.load(open(os.path.join(store_dir, MANIFEST)))
+    [(name, entry)] = manifest["entries"].items()
+    blob = os.path.join(store_dir, entry["payload"])
+    with open(blob, "wb") as fh:
+        fh.write(b"\x00garbage payload\x00")
+
+    timer = fresh_program()
+    stats = boot(timer, store_dir, "auto")
+    assert stats["miss_reasons"] == {"corrupt": 1}
+    assert stats["builds"] == 1
+    # the rewritten entry is valid again
+    stats = boot(fresh_program(), store_dir, "auto")
+    assert stats["hits"] == 1 and stats["misses"] == 0
+
+
+def test_torn_manifest_acts_as_empty_store(tmp_path):
+    store_dir = str(tmp_path / "store")
+    os.makedirs(store_dir)
+    with open(os.path.join(store_dir, MANIFEST), "w") as fh:
+        fh.write('{"entries": {"trunc')
+    stats = boot(fresh_program(), store_dir, "auto")
+    assert stats["miss_reasons"] == {"absent": 1}
+    assert stats["builds"] == 1
+    # and the rewrite produced a readable manifest
+    assert ExecutableStore(store_dir).entries()
+
+
+def test_fingerprint_mismatch_rewrites_entry(tmp_path):
+    store_dir = str(tmp_path / "store")
+    boot(fresh_program(), store_dir, "auto")
+    mpath = os.path.join(store_dir, MANIFEST)
+    manifest = json.load(open(mpath))
+    [name] = manifest["entries"]
+    live_fp = manifest["entries"][name]["fingerprint"]
+    manifest["entries"][name]["fingerprint"] = "0" * 16
+    json.dump(manifest, open(mpath, "w"))
+
+    stats = boot(fresh_program(), store_dir, "auto")
+    assert stats["miss_reasons"] == {"fingerprint": 1}
+    rewritten = json.load(open(mpath))["entries"][name]["fingerprint"]
+    assert rewritten == live_fp
+
+
+def test_static_argnum_dispatch(tmp_path):
+    store_dir = str(tmp_path / "store")
+
+    def make():
+        def g(x, n):
+            return x * float(n)
+
+        return observe.timed_first_call(
+            jax.jit(g, static_argnums=(1,)), "aot.test.static",
+            recompile_budget=4)
+
+    programs = [("aot.test.static", make(), (abstract_arg(), 3))]
+    stats = warm_boot(programs, AotConfig(cache_dir=store_dir, mode="auto"))
+    assert stats["builds"] == 1
+
+    timer = make()
+    stats = warm_boot([("aot.test.static", timer, (abstract_arg(), 3))],
+                      AotConfig(cache_dir=store_dir, mode="strict"))
+    assert stats["hits"] == 1
+    out = timer(jnp.ones(4, dtype=jnp.float32), 3)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    assert int(timer.__wrapped__.fallback._cache_size()) == 0
+    # a DIFFERENT static value is a different program: signature miss,
+    # falls back to the jit (which traces), never the wrong executable
+    out = timer(jnp.ones(4, dtype=jnp.float32), 5)
+    np.testing.assert_allclose(np.asarray(out), 5.0)
+    assert int(timer.__wrapped__.fallback._cache_size()) == 1
+
+
+def test_gc_removes_departed_entries(tmp_path):
+    store_dir = str(tmp_path / "store")
+    boot(fresh_program(), store_dir, "auto")
+    store = ExecutableStore(store_dir)
+    [name] = store.entries()
+    blob = os.path.join(store_dir, store.entries()[name]["payload"])
+    assert os.path.exists(blob)
+    removed = store.gc({})  # baselines.json no longer records the program
+    store.save()
+    assert removed == [name]
+    assert not ExecutableStore(store_dir).entries()
+    assert not os.path.exists(blob)
+
+
+def test_gc_keeps_matching_entries(tmp_path):
+    store_dir = str(tmp_path / "store")
+    boot(fresh_program(), store_dir, "auto")
+    store = ExecutableStore(store_dir)
+    [name] = store.entries()
+    fp = store.entries()[name]["fingerprint"]
+    assert store.gc({name: {"fingerprint": fp}}) == []
+    assert list(store.entries()) == [name]
+
+
+def test_first_call_resolver_hit_is_read_only(tmp_path):
+    store_dir = str(tmp_path / "store")
+    boot(fresh_program(), store_dir, "auto")
+    manifest_bytes = open(os.path.join(store_dir, MANIFEST), "rb").read()
+
+    resolver = FirstCallAotResolver(open_readonly(store_dir))
+    prev = observe.aot_resolver()
+    observe.set_aot_resolver(resolver)
+    try:
+        timer = fresh_program()
+        out = timer(jnp.arange(4, dtype=jnp.float32))
+    finally:
+        observe.set_aot_resolver(prev)
+    np.testing.assert_allclose(np.asarray(out), [1.0, 3.0, 5.0, 7.0])
+    assert resolver.stats["hits"] == 1 and resolver.stats["misses"] == 0
+    assert isinstance(timer.__wrapped__, AotDispatcher)
+    assert int(timer.__wrapped__.fallback._cache_size()) == 0
+    # never writes: the shared store's manifest is byte-identical
+    assert open(os.path.join(store_dir, MANIFEST), "rb").read() \
+        == manifest_bytes
+
+
+def test_first_call_resolver_miss_compiles_normally(tmp_path):
+    resolver = FirstCallAotResolver(
+        open_readonly(str(tmp_path / "missing-store")))
+    prev = observe.aot_resolver()
+    observe.set_aot_resolver(resolver)
+    try:
+        timer = fresh_program()
+        out = timer(jnp.arange(4, dtype=jnp.float32))
+    finally:
+        observe.set_aot_resolver(prev)
+    np.testing.assert_allclose(np.asarray(out), [1.0, 3.0, 5.0, 7.0])
+    assert resolver.stats["misses"] == 1
+    assert int(timer.__wrapped__.fallback._cache_size()) == 1
+
+
+def test_verify_against_flags_drift_and_absence(tmp_path):
+    store_dir = str(tmp_path / "store")
+    boot(fresh_program(), store_dir, "auto")
+    store = ExecutableStore(store_dir)
+    [name] = store.entries()
+    entry = store.entries()[name]
+    clean_baseline = {"entries": {name: {
+        "fingerprint": entry["fingerprint"],
+        "interface": {"sha": entry["interface_sha"]},
+    }}}
+    assert store.verify_against(clean_baseline, allow={}) == []
+
+    drifted = {"entries": {name: {"fingerprint": "0" * 16,
+                                  "interface": {"sha": "x"}},
+                           "other.program": {"fingerprint": "1" * 16}}}
+    findings = store.verify_against(drifted, allow={})
+    assert findings and all(f.rule_id == "DP305" for f in findings)
+    msgs = "\n".join(f.message for f in findings)
+    assert f"[{name}]" in msgs          # fingerprint drift
+    assert "[other.program]" in msgs    # baselined, no store entry
+
+    # allowlist is the DP305 suppression channel (manifest.json has no
+    # source line for a noqa)
+    allow = {name: {"DP305": "known"}, "other.program": {"DP305": "known"}}
+    assert store.verify_against(drifted, allow=allow) == []
+
+
+def test_call_signature_discriminates():
+    a4 = jnp.zeros((4,), jnp.float32)
+    a8 = jnp.zeros((8,), jnp.float32)
+    base = call_signature((a4,), {})
+    assert call_signature((abstract_arg((4,)),), {}) == base  # abstract==live
+    assert call_signature((a8,), {}) != base                  # shape
+    assert call_signature((a4.astype(jnp.int32),), {}) != base  # dtype
+    assert call_signature((a4, 3), {}) != call_signature((a4, 5), {})  # static
+    assert call_signature((a4,), {"k": a4}) != base           # structure

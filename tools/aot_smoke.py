@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""AOT executable-store smoke: zero-trace warm boot end-to-end (CI gate,
+`run_tests.sh`).
+
+Five phases, one process, one throwaway store, one stub victim:
+
+A. COLD — a service with no store boots (traces + compiles everything),
+   answers a seeded batch; its verdicts are the parity reference.
+B. BUILD — a fresh service in mode "auto" against the empty store misses
+   everywhere, compiles, and populates one entry per serving program.
+C. WARM — a fresh service in mode "strict" boots purely from the store
+   with the recompile watchdog ARMED (`enforce_budgets=True` arms it
+   before the warm boot runs): every program must hit, the total trace
+   count must be 0 after boot AND after live traffic, and the verdicts on
+   the same seeded batch must equal phase A's.
+D. DRIFT — one manifest fingerprint is planted stale; an "auto" boot must
+   miss exactly that program, recompile it, and REWRITE the entry back to
+   the live fingerprint (never serve stale).
+E. REFUSE — `python -m dorpatch_tpu.aot build` against a doctored
+   baselines.json (one fingerprint flipped) must exit 1 and write nothing.
+
+Prints ONE JSON line: {"metric": "aot_smoke", "ok": true, ...}; exits
+non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu.aot import build as aot_build
+    from dorpatch_tpu.aot.store import MANIFEST
+    from dorpatch_tpu.config import AotConfig, DefenseConfig, ServeConfig
+
+    # the serve smoke's stub victim shape: deterministic, jit-friendly,
+    # classes depend on mean brightness so masking can flip verdicts.
+    # A FRESH closure per service: jax.jit shares its trace cache across
+    # wrappers of the same function object, so reusing one apply_fn would
+    # leak the cold phase's trace counts into the warm service's
+    # zero-trace accounting.
+    num_classes, img = 5, 32
+
+    def make_apply():
+        def apply_fn(params, x):
+            s = x.mean(axis=(1, 2, 3))
+            return jax.nn.one_hot((s * 7.0).astype(jnp.int32) % num_classes,
+                                  num_classes)
+        return apply_fn
+
+    serve_cfg = ServeConfig(max_batch=4, bucket_sizes=(1, 4))
+    defense_cfg = DefenseConfig(ratios=(0.1,), chunk_size=64)
+    rng = np.random.default_rng(0)
+    images = rng.uniform(0.0, 1.0, (6, img, img, 3)).astype(np.float32)
+
+    from dorpatch_tpu.serve.service import CertifiedInferenceService
+
+    def make(aot_cfg):
+        return CertifiedInferenceService(
+            make_apply(), None, num_classes, img, serve_cfg=serve_cfg,
+            defense_cfg=defense_cfg, aot_cfg=aot_cfg)
+
+    def drive(svc):
+        out = []
+        for im in images:
+            r = svc.predict(im, deadline_ms=60000)
+            if r.status != "ok":
+                raise AssertionError(f"predict failed: {r!r}")
+            out.append((r.prediction, r.certified, r.clean_prediction))
+        return out
+
+    failures = []
+    stats = {"metric": "aot_smoke"}
+    store_dir = tempfile.mkdtemp(prefix="aot-smoke-store-")
+    refuse_dir = tempfile.mkdtemp(prefix="aot-smoke-refuse-")
+    doctored = tempfile.NamedTemporaryFile(
+        mode="w", suffix=".json", delete=False)
+    try:
+        # ---- A: cold reference ----
+        cold = make(None)
+        cold.start()
+        n_programs = len(cold.trace_entrypoints())
+        want = drive(cold)
+        cold_traces = sum(cold.trace_counts().values())
+        cold.stop()
+        stats["programs"] = n_programs
+        stats["cold_trace_count"] = cold_traces
+        if cold_traces <= 0:
+            failures.append("cold service reports zero traces — the "
+                            "trace accounting this smoke relies on is dead")
+
+        # ---- B: populate the store ----
+        builder = make(AotConfig(cache_dir=store_dir, mode="auto"))
+        builder.start()
+        bstats = builder._aot_stats or {}
+        builder.stop()
+        stats["build"] = {"hits": bstats.get("hits"),
+                          "misses": bstats.get("misses"),
+                          "builds": bstats.get("builds")}
+        if bstats.get("builds") != n_programs:
+            failures.append(
+                f"build pass wrote {bstats.get('builds')} entries, expected "
+                f"{n_programs} (one per serving program)")
+
+        # ---- C: strict warm boot under the armed watchdog ----
+        warm = make(AotConfig(cache_dir=store_dir, mode="strict"))
+        warm.start()   # AotBootError here IS the failure: strict miss
+        wstats = warm._aot_stats or {}
+        boot_traces = sum(warm.trace_counts().values())
+        got = drive(warm)
+        traffic_traces = sum(warm.trace_counts().values())
+        warm.stop()
+        stats["warm"] = {"hits": wstats.get("hits"),
+                         "misses": wstats.get("misses"),
+                         "boot_trace_count": boot_traces,
+                         "traffic_trace_count": traffic_traces}
+        if wstats.get("hits") != n_programs or wstats.get("misses", 1) != 0:
+            failures.append(
+                f"strict warm boot: {wstats.get('hits')} hits / "
+                f"{wstats.get('misses')} misses, expected {n_programs}/0")
+        if boot_traces != 0:
+            failures.append(
+                f"warm boot traced {boot_traces} program(s) — the "
+                f"zero-trace contract is broken at startup")
+        if traffic_traces != 0:
+            failures.append(
+                f"warm traffic traced {traffic_traces} program(s) under "
+                f"the armed watchdog")
+        if got != want:
+            failures.append(f"verdict parity broke: cold {want} "
+                            f"vs warm {got}")
+
+        # ---- D: planted fingerprint drift -> exactly one rebuild ----
+        mpath = os.path.join(store_dir, MANIFEST)
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+        victim_name = sorted(manifest["entries"])[0]
+        live_fp = manifest["entries"][victim_name]["fingerprint"]
+        manifest["entries"][victim_name]["fingerprint"] = "0" * 16
+        with open(mpath, "w") as fh:
+            json.dump(manifest, fh)
+        drift = make(AotConfig(cache_dir=store_dir, mode="auto"))
+        drift.start()
+        dstats = drift._aot_stats or {}
+        drift.stop()
+        stats["drift"] = {"victim": victim_name,
+                          "misses": dstats.get("misses"),
+                          "builds": dstats.get("builds"),
+                          "miss_reasons": dstats.get("miss_reasons")}
+        if dstats.get("misses") != 1 or dstats.get("builds") != 1:
+            failures.append(
+                f"planted drift on {victim_name}: {dstats.get('misses')} "
+                f"miss(es) / {dstats.get('builds')} build(s), expected 1/1")
+        with open(mpath) as fh:
+            rewritten = json.load(fh)["entries"][victim_name]["fingerprint"]
+        if rewritten != live_fp:
+            failures.append(
+                f"drifted entry {victim_name} was not rewritten to the "
+                f"live fingerprint ({rewritten!r} != {live_fp!r})")
+
+        # ---- E: aot build refuses on a failing --baseline check ----
+        from dorpatch_tpu.analysis.baseline import baseline_path
+
+        with open(baseline_path()) as fh:
+            baseline = json.load(fh)
+        name = sorted(baseline["entries"])[0]
+        baseline["entries"][name]["fingerprint"] = "0" * 16
+        json.dump(baseline, doctored)
+        doctored.close()
+        rc = aot_build.main(["build", "--store", refuse_dir,
+                             "--baseline-file", doctored.name])
+        wrote = os.path.exists(os.path.join(refuse_dir, MANIFEST))
+        stats["refuse"] = {"rc": rc, "wrote_manifest": wrote}
+        if rc != 1:
+            failures.append(f"aot build against a drifted baseline "
+                            f"returned rc={rc}, expected 1 (refusal)")
+        if wrote:
+            failures.append("aot build wrote a manifest despite refusing")
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+        shutil.rmtree(refuse_dir, ignore_errors=True)
+        try:
+            os.unlink(doctored.name)
+        except OSError:
+            pass
+
+    stats["ok"] = not failures
+    stats["failures"] = failures
+    print(json.dumps(stats))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
