@@ -697,7 +697,9 @@ def child_boot() -> None:
             return jax.nn.one_hot((s * 7.0).astype(jnp.int32) % 5, 5)
         return apply_fn
 
-    serve_cfg = ServeConfig(max_batch=4, bucket_sizes=(1, 4))
+    # replicas pinned to 1: the boot benchmark measures one bank's
+    # cold-vs-warm compile wall clock, not pool spin-up
+    serve_cfg = ServeConfig(max_batch=4, bucket_sizes=(1, 4), replicas=1)
     defense_cfg = DefenseConfig(ratios=(0.06,), chunk_size=64)
 
     def make(aot_cfg):

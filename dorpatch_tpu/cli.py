@@ -157,6 +157,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-deadline-ms", type=float, default=2000.0,
                    help="default per-request latency budget; the batcher "
                         "flushes a partial batch once half of it is spent")
+    p.add_argument("--serve-replicas", type=int, default=1,
+                   help="replica pool size: worker loops sharing the one "
+                        "micro-batcher queue, each owning an independent "
+                        "jitted program bank; a supervisor quarantines and "
+                        "restarts sick replicas (serve/pool.py)")
+    p.add_argument("--serve-max-restarts", type=int, default=2,
+                   help="restarts a quarantined replica gets (AOT warm "
+                        "boot when --aot-cache is set) before it retires "
+                        "and the pool degrades to reduced capacity")
+    p.add_argument("--serve-restart-backoff-base", type=float, default=0.5,
+                   help="replica restart backoff base seconds (shared "
+                        "backoff.retry_delay: base * 2^(n-1), capped, "
+                        "deterministic jitter)")
+    p.add_argument("--serve-restart-backoff-cap", type=float, default=30.0,
+                   help="replica restart backoff cap seconds")
+    p.add_argument("--serve-replica-stale-s", type=float, default=0.0,
+                   help="missed-beat staleness window before the "
+                        "supervisor declares a replica wedged (0 = derive "
+                        "from --serve-deadline-ms); raise it above the "
+                        "slowest legitimate batch — replicas beat only at "
+                        "batch boundaries, so a window shorter than one "
+                        "batch false-positives a healthy replica as "
+                        "wedged (first-execution batches on a cold, slow "
+                        "host are the usual trap)")
     # AOT executable store (`python -m dorpatch_tpu.aot build` writes it;
     # serve/farm warm-boot from it — README "AOT executable store")
     p.add_argument("--aot-cache", default="",
@@ -188,9 +212,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(base * 2^(attempt-1), capped, plus deterministic "
                         "per-job jitter)")
     p.add_argument("--chaos", default="",
-                   help="attack-sweep farm fault injection (smoke/recovery "
-                        "testing): comma-joined list of crash_block, "
-                        "ckpt_raise, wedge_heartbeat, enospc_events")
+                   help="deterministic fault injection (smoke/recovery "
+                        "testing; dorpatch_tpu.chaos): comma-joined list. "
+                        "Farm faults: crash_block, ckpt_raise, "
+                        "wedge_heartbeat, enospc_events. Serve faults "
+                        "(python -m dorpatch_tpu.serve): wedge_dispatch, "
+                        "raise_in_worker, wedge_heartbeat")
     p.add_argument("--remat-policy", default="full",
                    choices=["full", "conv", "dots"],
                    help="what an active remat recomputes: full = the whole "
@@ -259,7 +286,13 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         serve=ServeConfig(port=args.serve_port,
                           max_batch=args.serve_max_batch,
                           max_queue_depth=args.serve_queue_depth,
-                          deadline_ms=args.serve_deadline_ms),
+                          deadline_ms=args.serve_deadline_ms,
+                          replicas=args.serve_replicas,
+                          max_restarts=args.serve_max_restarts,
+                          restart_backoff_base=args.serve_restart_backoff_base,
+                          restart_backoff_cap=args.serve_restart_backoff_cap,
+                          replica_stale_s=args.serve_replica_stale_s,
+                          chaos=args.chaos),
         farm=FarmConfig(lease_ttl=args.farm_lease_ttl,
                         max_attempts=args.farm_max_attempts,
                         backoff_base=args.farm_backoff_base,

@@ -181,6 +181,17 @@ class ServeConfig:
     host: str = "127.0.0.1"
     port: int = 8700                # HTTP front-end bind port (0 = ephemeral)
     warmup: bool = True             # compile every bucket's programs at start
+    # -- replica pool / failover (serve/pool.py) --
+    replicas: int = 1               # worker loops sharing the queue, each
+                                    # with its own jitted program bank
+    max_restarts: int = 2           # quarantined-replica restarts before it
+                                    # retires (0 = a failed replica is gone)
+    restart_backoff_base: float = 0.5   # shared backoff.retry_delay knobs
+    restart_backoff_cap: float = 30.0   # for replica restarts (seconds)
+    replica_stale_s: float = 0.0    # missed-beat staleness threshold for
+                                    # the supervisor; 0 = deadline_ms/1e3
+    chaos: str = ""                 # serve-side fault injection (comma list
+                                    # of dorpatch_tpu.chaos SERVE_FAULTS)
 
 
 @dataclasses.dataclass(frozen=True)

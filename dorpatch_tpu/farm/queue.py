@@ -42,7 +42,6 @@ status/report CLIs stay cheap.
 
 from __future__ import annotations
 
-import hashlib
 import itertools
 import json
 import os
@@ -50,6 +49,9 @@ import re
 import time
 from typing import Dict, List, Optional, Tuple
 
+# Backoff math lives in the shared module (serve replica restarts use the
+# same formula); re-exported here because the farm grew it first.
+from dorpatch_tpu.backoff import retry_delay  # noqa: F401
 from dorpatch_tpu.checkpoint import atomic_write_json, load_json
 from dorpatch_tpu.observe.heartbeat import last_beat_ts
 
@@ -83,16 +85,6 @@ def job_slug(params: Dict) -> str:
     return re.sub(r"[^A-Za-z0-9._=-]+", "_", "_".join(parts))[:80]
 
 
-def retry_delay(job_id: str, attempt: int, base: float = 2.0,
-                cap: float = 300.0, jitter: float = 0.25) -> float:
-    """Exponential backoff with *deterministic* jitter seeded from the job
-    id and attempt number: retries are exactly reproducible (no flaky
-    recovery tests), while a burst of simultaneous failures still spreads
-    its retries instead of thundering back in lockstep."""
-    delay = min(float(cap), float(base) * (2.0 ** max(0, attempt - 1)))
-    seed = int.from_bytes(
-        hashlib.sha256(f"{job_id}:{attempt}".encode()).digest()[:4], "big")
-    return delay * (1.0 + float(jitter) * (seed / 2.0 ** 32))
 
 
 class JobQueue:

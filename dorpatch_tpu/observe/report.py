@@ -178,6 +178,7 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
                         peak_flops=float(tele["peak_flops"]))
 
     serve = _summarize_serve(ev)
+    replicas = _summarize_replicas(ev)
     baseline = _load_baseline_check(result_dir)
 
     metrics_by_attempt: Dict[str, int] = {}
@@ -228,6 +229,7 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
         },
         "mfu": mfu,
         "serve": serve,
+        "replicas": replicas,
         "aot": _summarize_aot(ev),
         "baseline": baseline,
         "peak_device_bytes": peak_mem or None,
@@ -296,6 +298,67 @@ def _summarize_serve(ev: List[dict]) -> Optional[dict]:
         if fe and ok_lat else None,
         "certify_prune_rate": round(1.0 - fwd / fwd_exh, 4)
         if fwd and fwd_exh else None,
+    }
+
+
+def _summarize_replicas(ev: List[dict]) -> Optional[dict]:
+    """The replica-pool section: per-replica lifecycle accounting from the
+    `serve.replica.{start,sick,quarantine,restart,retire}` events the
+    supervised pool emits, plus per-replica batch counts from the
+    `serve.batch` spans. None when the dir predates the replica pool (or
+    the run never served) so old reports render unchanged."""
+    life = [r for r in ev if r.get("kind") == "event"
+            and str(r.get("name", "")).startswith("serve.replica.")]
+    if not life:
+        return None
+    batches = [r for r in ev
+               if r.get("kind") == "span" and r.get("name") == "serve.batch"]
+    per: Dict[int, dict] = {}
+
+    def rep(i):
+        return per.setdefault(int(i), {
+            "replica": int(i), "generation": 0, "restarts": 0,
+            "sick": 0, "sick_kinds": {}, "retired": False,
+            "failed_over": 0, "batches": 0, "aot": None})
+
+    drains = 0
+    for r in life:
+        if "replica" not in r:
+            continue
+        p = rep(r["replica"])
+        name = r["name"]
+        if name == "serve.replica.start":
+            p["generation"] = max(p["generation"], int(r.get("generation", 0)))
+            if r.get("aot") is not None:
+                p["aot"] = bool(r["aot"])
+        elif name == "serve.replica.sick":
+            p["sick"] += 1
+            cause = str(r.get("cause", "?"))
+            p["sick_kinds"][cause] = p["sick_kinds"].get(cause, 0) + 1
+            p["failed_over"] += int(r.get("inflight", 0))
+        elif name == "serve.replica.restart":
+            p["generation"] = max(p["generation"], int(r.get("generation", 0)))
+            p["restarts"] = max(p["restarts"], int(r.get("restarts", 0)))
+            p["restart_s"] = round(float(r.get("dur_s", 0.0)), 3)
+            p["restart_traces"] = int(r.get("trace_counts", 0))
+        elif name == "serve.replica.quarantine":
+            p["restarts"] = max(p["restarts"], int(r.get("restarts", 0)))
+        elif name == "serve.replica.retire":
+            p["retired"] = True
+            p["restarts"] = max(p["restarts"], int(r.get("restarts", 0)))
+    for b in batches:
+        if "replica" in b:
+            rep(b["replica"])["batches"] += 1
+    drains = sum(1 for r in ev if r.get("kind") == "event"
+                 and r.get("name") == "serve.drain_timeout")
+    out = sorted(per.values(), key=lambda p: p["replica"])
+    return {
+        "count": len(out),
+        "retired": sum(1 for p in out if p["retired"]),
+        "restarts": sum(p["restarts"] for p in out),
+        "failed_over": sum(p["failed_over"] for p in out),
+        "drain_timeouts": drains,
+        "per_replica": out,
     }
 
 
@@ -455,6 +518,27 @@ def format_report(s: dict) -> str:
                 incr = f" ({fe} full-forward equivalents, incremental)"
             add(f"  certify forwards: "
                 f"{sv['certify_forwards_per_request']}/request{incr}{prune}")
+
+    rp = s.get("replicas")
+    if rp:
+        add("-- replicas --")
+        add(f"  pool: {rp['count']} replica(s), {rp['restarts']} restart(s), "
+            f"{rp['retired']} retired, "
+            f"{rp['failed_over']} request(s) failed over"
+            + (f", {rp['drain_timeouts']} drain timeout(s)"
+               if rp["drain_timeouts"] else ""))
+        for p in rp["per_replica"]:
+            sick = (" sick[" + ", ".join(f"{k}: {v}" for k, v
+                                         in sorted(p["sick_kinds"].items()))
+                    + "]" if p["sick_kinds"] else "")
+            restart = ""
+            if "restart_s" in p:
+                restart = (f" last restart {p['restart_s']}s "
+                           f"({p['restart_traces']} trace(s))")
+            add(f"  r{p['replica']}: gen {p['generation']}, "
+                f"{p['batches']} batch(es), {p['restarts']} restart(s)"
+                f"{sick}{restart}"
+                + (" RETIRED" if p["retired"] else ""))
 
     ao = s.get("aot")
     if ao:

@@ -10,11 +10,14 @@ front-end, and full events.jsonl telemetry.
     service.predict(image)       # direct Python client (no sockets)
 
 See `service.py` for the request lifecycle, `batcher.py` for the
-size-or-deadline flush rules, `types.py` for the typed responses.
+size-or-deadline flush rules, `types.py` for the typed responses, and
+`pool.py` for the supervised replica pool (N worker loops, per-replica
+health, failover re-dispatch, AOT-warm restarts).
 """
 
 from dorpatch_tpu.serve.batcher import MicroBatcher, PendingRequest  # noqa: F401
 from dorpatch_tpu.serve.http import HttpFrontend  # noqa: F401
+from dorpatch_tpu.serve.pool import Replica, ReplicaPool  # noqa: F401
 from dorpatch_tpu.serve.service import (  # noqa: F401
     CertifiedInferenceService,
     marshal_response,
@@ -39,6 +42,8 @@ __all__ = [
     "PendingRequest",
     "PredictResult",
     "RadiusVerdict",
+    "Replica",
+    "ReplicaPool",
     "ServeError",
     "marshal_response",
     "resolved_bucket_sizes",

@@ -3,8 +3,11 @@ service over the configured victim and serve HTTP until interrupted.
 
 Reuses the experiment CLI surface (`dorpatch_tpu.cli.build_parser`): model/
 dataset/defense flags select what is served, the `--serve-*` group sizes
-the micro-batcher and front-end. Telemetry lands in
-`<results_root>/serve/` (run.json + events.jsonl); render it with
+the micro-batcher, replica pool (`--serve-replicas`, restart policy), and
+front-end; `--chaos wedge_dispatch,raise_in_worker,wedge_heartbeat` arms
+the serve-side fault injection (dorpatch_tpu.chaos) against replica 0 for
+recovery drills. Telemetry lands in `<results_root>/serve/` (run.json +
+events.jsonl); render it with
 `python -m dorpatch_tpu.observe.report <results_root>/serve`.
 """
 
@@ -25,9 +28,11 @@ def main(argv=None) -> int:
     with service:
         observe.log(
             f"serve: warm ({service.trace_counts()}) — "
+            f"replicas {cfg.serve.replicas}, "
             f"buckets {list(service.bucket_sizes)}, "
             f"queue depth {service.batcher.max_queue_depth}, "
-            f"deadline {cfg.serve.deadline_ms:g} ms")
+            f"deadline {cfg.serve.deadline_ms:g} ms"
+            + (f", chaos [{cfg.serve.chaos}]" if cfg.serve.chaos else ""))
         with HttpFrontend(service, cfg.serve.host, cfg.serve.port):
             try:
                 while True:

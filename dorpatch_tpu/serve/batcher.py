@@ -33,9 +33,17 @@ from typing import List, Optional, Sequence
 
 class PendingRequest:
     """One queued request: the image, its absolute deadline (perf-clock
-    seconds), and the event/result slot the submitting thread waits on."""
+    seconds), and the event/result slot the submitting thread waits on.
 
-    __slots__ = ("image", "enqueued", "deadline", "done", "result")
+    Resolution is FIRST-WINS: with replica failover a request can briefly be
+    visible to two resolvers (the stale replica that was holding it and the
+    healthy one it was re-dispatched to), and the contract is exactly one
+    answer — the loser's response is shed, never delivered. `claim()` is the
+    atomic arbiter; callers that need to account for the outcome *before*
+    waking the waiter claim first, then `deliver()`."""
+
+    __slots__ = ("image", "enqueued", "deadline", "done", "result",
+                 "redispatched", "_claim_lock", "_claimed")
 
     def __init__(self, image, enqueued: float, deadline: float):
         self.image = image
@@ -43,13 +51,36 @@ class PendingRequest:
         self.deadline = deadline
         self.done = threading.Event()
         self.result = None
+        # set by the supervisor on failover: at most ONE re-enqueue per
+        # request — a second replica failure resolves it as an error
+        self.redispatched = False
+        self._claim_lock = threading.Lock()
+        self._claimed = False
 
     def budget_s(self) -> float:
         return self.deadline - self.enqueued
 
-    def resolve(self, result) -> None:
+    def claim(self) -> bool:
+        """Atomically win the exclusive right to answer this request.
+        Exactly one caller ever sees True."""
+        with self._claim_lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
+
+    def deliver(self, result) -> None:
+        """Publish the result and wake the waiter. Only the `claim()`
+        winner may call this."""
         self.result = result
         self.done.set()
+
+    def resolve(self, result) -> bool:
+        """claim + deliver in one step; True if this call won."""
+        if not self.claim():
+            return False
+        self.deliver(result)
+        return True
 
 
 class MicroBatcher:
@@ -83,9 +114,40 @@ class MicroBatcher:
             self._cond.notify_all()
             return True
 
+    def requeue(self, reqs: Sequence[PendingRequest]) -> bool:
+        """Failover re-enqueue: put a failed replica's in-flight requests at
+        the FRONT of the queue (they have already burned queue time) in
+        their original arrival order. Deliberately exempt from the depth
+        bound — these requests were admitted once and backpressure must not
+        turn a replica failure into silent loss. False only when the
+        batcher is closed (the caller resolves them as errors instead)."""
+        with self._cond:
+            if self._closed:
+                return False
+            self._pending.extendleft(reversed(list(reqs)))
+            self._cond.notify_all()
+            return True
+
     def qsize(self) -> int:
         with self._cond:
             return len(self._pending)
+
+    def set_max_queue_depth(self, depth: int) -> None:
+        """Degraded-capacity backpressure: when replicas retire, the pool
+        shrinks the admission bound so the service rejects with
+        `Overloaded` sooner instead of queueing work it can no longer
+        answer inside a deadline. Already-queued requests are unaffected."""
+        with self._cond:
+            self.max_queue_depth = max(0, int(depth))
+
+    def drain(self) -> List[PendingRequest]:
+        """Remove and return every queued request (terminal degradation:
+        nobody is left to serve them; the pool resolves them as typed
+        errors so no waiter hangs)."""
+        with self._cond:
+            out = list(self._pending)
+            self._pending.clear()
+            return out
 
     @property
     def closed(self) -> bool:
@@ -111,19 +173,29 @@ class MicroBatcher:
         must still flush inside its own budget (head-of-line starvation)."""
         return min(self._flush_at(r) for r in self._pending)
 
-    def next_batch(self) -> Optional[List[PendingRequest]]:
+    def next_batch(self, timeout: Optional[float] = None
+                   ) -> Optional[List[PendingRequest]]:
         """Block until a flush triggers; returns up to `max_batch` requests
-        in arrival order, or None when closed and fully drained."""
+        in arrival order, or None when closed and fully drained.
+
+        With `timeout`, returns an EMPTY list once that many seconds pass
+        with no flush — the replica worker's idle heartbeat tick: the
+        supervisor's missed-beat staleness detection needs workers to prove
+        liveness on a bounded cadence even when no traffic arrives, and a
+        worker parked forever inside this wait could not."""
         with self._cond:
+            give_up = None if timeout is None else self._clock() + timeout
             while True:
+                now = self._clock()
                 if self._pending:
-                    now = self._clock()
                     if (len(self._pending) >= self.max_batch
                             or self._closed
                             or now >= self._next_flush()):
                         return [self._pending.popleft()
                                 for _ in range(min(len(self._pending),
                                                    self.max_batch))]
+                    if give_up is not None and now >= give_up:
+                        return []
                     # sleep until the earliest flush instant; a submit that
                     # fills the bucket (or carries a tighter deadline)
                     # notifies us and we recompute. The wait is clamped:
@@ -133,8 +205,15 @@ class MicroBatcher:
                     wait_s = self._next_flush() - now
                     if not (wait_s > 0.0):  # also catches NaN
                         wait_s = 0.05
-                    self._cond.wait(min(wait_s, 60.0))
+                    wait_s = min(wait_s, 60.0)
+                    if give_up is not None:
+                        wait_s = min(wait_s, max(give_up - now, 0.0))
+                    self._cond.wait(wait_s)
                 elif self._closed:
                     return None
+                elif give_up is not None:
+                    if now >= give_up:
+                        return []
+                    self._cond.wait(give_up - now)
                 else:
                     self._cond.wait()

@@ -1,0 +1,494 @@
+"""Supervised replica pool: N worker loops, health, failover, restarts.
+
+The single `serve-worker` thread of the original service becomes a pool of
+replicas sharing ONE micro-batcher queue:
+
+- **Replica** — one worker loop plus everything it exclusively owns: a
+  fresh `apply_fn` closure's jitted program bank (jit caches are per
+  wrapper object, so a fresh closure per replica keeps trace caches — and
+  therefore warmup, AOT boot, and the zero-recompile contract — fully
+  independent), a manual-beat heartbeat (`observe.Heartbeat` semantics:
+  what proves liveness is the *beat*, not the thread object), and the
+  in-flight batch it is currently answering.
+- **Supervisor** — a thread that classifies sick replicas by TYPE:
+  `wedged` (thread alive, beats stale — a stuck device call),
+  `raised` (thread died on an escaped exception), `recompile_budget`
+  (the PR 2 watchdog tripped: the replica is structurally retracing and
+  must be rebuilt, ideally from the AOT store). A sick replica's in-flight
+  requests are re-dispatched to the healthy replicas at most once each,
+  inside their original deadlines — `PendingRequest.claim()` makes a late
+  answer from the sick replica a shed duplicate, never a double answer.
+- **Restarts** — quarantined replicas come back through the PR 10 AOT warm
+  boot (zero traces under the armed watchdog when the store has the
+  programs) after the shared `backoff.retry_delay` wait; a replica that
+  exhausts `max_restarts` retires and the pool degrades gracefully:
+  admission (`max_queue_depth`) shrinks with the healthy fraction so
+  clients see `Overloaded` sooner, and the LAST retirement drains the
+  queue with typed errors — the service never hangs, it only shrinks.
+
+Replica state machine: healthy -> sick -> quarantined -> (restarting ->
+healthy)* -> retired. Telemetry: `serve.replica.{start,sick,quarantine,
+restart,retire}` events (rendered by `observe.report` as `-- replicas --`),
+per-replica occupancy/latency/trace counts in `/stats`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, List, Optional
+
+from dorpatch_tpu import observe
+from dorpatch_tpu.backoff import retry_delay
+from dorpatch_tpu.serve.types import DeadlineExceeded, ServeError
+
+# replica lifecycle states (see module docstring)
+STATES = ("healthy", "sick", "quarantined", "restarting", "retired")
+
+
+class ReplicaHeartbeat(observe.Heartbeat):
+    """Manual-beat heartbeat for one replica worker thread.
+
+    No daemon thread: the worker loop itself beats at batch boundaries and
+    idle wakeups, so a wedged dispatch stops the beats — exactly the
+    missed-beat staleness signal `farm/queue.py` uses for lease expiry.
+    Keeps the last beat on the service's monotonic clock for the
+    supervisor's cheap in-process staleness reads; the optional JSONL file
+    (`heartbeat_r<slot>.jsonl` under the results dir) is the post-mortem
+    artifact, same format as every other heartbeat in the system."""
+
+    def __init__(self, path: Optional[str], slot: int, clock):
+        super().__init__(path, interval=3600.0, process_index=slot)
+        self._mono = clock
+        self.last = clock()
+        self.last_phase = "init"
+
+    def mark(self, phase: str) -> None:
+        if self._wedged:  # a wedged heartbeat freezes; the thread may live
+            return
+        self.last = self._mono()
+        self.last_phase = phase
+        self.beat(phase)
+
+    def stale_s(self, now: float) -> float:
+        return now - self.last
+
+
+class Replica:
+    """One worker loop's exclusive state; all mutation happens under
+    `lock` or from the owning worker thread."""
+
+    def __init__(self, slot: int, clean, defenses, heartbeat: ReplicaHeartbeat,
+                 aot_stats: Optional[dict] = None):
+        self.slot = int(slot)
+        self.generation = 0
+        self.state = "healthy"
+        self.restarts = 0
+        self.clean = clean
+        self.defenses = defenses
+        self.hb = heartbeat
+        self.aot_stats = aot_stats
+        self.thread: Optional[threading.Thread] = None
+        self.lock = threading.Lock()
+        self.inflight: List[Any] = []
+        self.fail_kind: Optional[str] = None
+        self.fail_error: Optional[str] = None
+        self.restart_at: Optional[float] = None
+        # per-replica accounting (the pool's /stats and report rows)
+        self.batches = 0
+        self.batch_images = 0
+        self.batch_slots = 0
+        self.completed = 0
+        self.duplicates_shed = 0
+        self.latencies_ms: List[float] = []
+
+    def begin_batch(self, reqs: List[Any]) -> None:
+        with self.lock:
+            self.inflight = list(reqs)
+
+    def end_batch(self) -> None:
+        with self.lock:
+            self.inflight = []
+
+    def take_inflight(self) -> List[Any]:
+        with self.lock:
+            reqs, self.inflight = self.inflight, []
+            return reqs
+
+    def thread_alive(self) -> bool:
+        t = self.thread
+        return t is not None and t.is_alive()
+
+
+class ReplicaPool:
+    """Owns the replicas, their worker threads, and the supervisor; the
+    `CertifiedInferenceService` delegates dispatch/health/stats here and
+    keeps the client API, program building, and telemetry contract."""
+
+    def __init__(self, service, chaos=None):
+        self.svc = service
+        self.cfg = service.serve_cfg
+        self.batcher = service.batcher
+        self._clock = service._clock
+        self._chaos = chaos
+        self.replicas: List[Replica] = []
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._supervisor: Optional[threading.Thread] = None
+        self._base_depth = service.batcher.max_queue_depth
+        self.redispatched = 0
+        self.duplicates_shed = 0
+        # staleness threshold: a healthy batch must finish well inside the
+        # request deadline (the batcher flushes at flush_fraction of it),
+        # so a replica silent for a full deadline is stuck, not slow
+        stale = float(getattr(self.cfg, "replica_stale_s", 0.0) or 0.0)
+        self.stale_after_s = (stale if stale > 0.0
+                              else max(self.cfg.deadline_ms / 1e3, 0.5))
+        self.poll_s = max(0.05, self.stale_after_s / 4.0)
+
+    # ---------------- lifecycle ----------------
+
+    def _hb_path(self, slot: int) -> Optional[str]:
+        if not self.svc.result_dir:
+            return None
+        return os.path.join(self.svc.result_dir, f"heartbeat_r{slot}.jsonl")
+
+    def start(self) -> "ReplicaPool":
+        n = max(1, int(getattr(self.cfg, "replicas", 1)))
+        # replica 0 adopts the service's own bank — the one `start()`
+        # already AOT-booted and warmed, and the one `trace_entrypoints`
+        # / the baseline gate enumerate
+        r0 = Replica(0, self.svc._clean, self.svc.defenses,
+                     ReplicaHeartbeat(self._hb_path(0), 0, self._clock),
+                     aot_stats=self.svc._aot_stats)
+        self.replicas = [r0]
+        for slot in range(1, n):
+            clean, defenses, aot_stats = self.svc._build_bank(slot)
+            self.replicas.append(
+                Replica(slot, clean, defenses,
+                        ReplicaHeartbeat(self._hb_path(slot), slot,
+                                         self._clock),
+                        aot_stats=aot_stats))
+        for r in self.replicas:
+            self._launch(r)
+            observe.record_event("serve.replica.start", replica=r.slot,
+                                 generation=r.generation,
+                                 aot=bool(r.aot_stats))
+        self._supervisor = threading.Thread(target=self._supervise,
+                                            name="serve-supervisor",
+                                            daemon=True)
+        self._supervisor.start()
+        return self
+
+    def _launch(self, replica: Replica) -> None:
+        replica.thread = threading.Thread(
+            target=self._worker_main, args=(replica,),
+            name=f"serve-worker-r{replica.slot}g{replica.generation}",
+            daemon=True)
+        replica.thread.start()
+
+    def begin_stop(self) -> None:
+        """Stop supervising BEFORE the batcher closes: draining workers
+        exit their loops naturally and must not be classified as failures."""
+        self._stop_evt.set()
+
+    def join(self, timeout_s: float) -> bool:
+        """Join the current-generation worker threads (abandoned wedged
+        generations died to the supervisor long ago and are daemon
+        threads); True when every live worker drained in time."""
+        sup = self._supervisor
+        if sup is not None:
+            sup.join(timeout=5.0)
+        deadline = self._clock() + max(timeout_s, 0.0)
+        for r in self.replicas:
+            t = r.thread
+            if t is None or not t.is_alive():
+                continue
+            t.join(timeout=max(deadline - self._clock(), 0.0))
+        return not any(r.thread_alive() for r in self.replicas)
+
+    def still_draining(self) -> List[int]:
+        return [r.slot for r in self.replicas if r.thread_alive()]
+
+    # ---------------- health ----------------
+
+    def worker_alive(self) -> bool:
+        return any(r.thread_alive() for r in self.replicas)
+
+    def healthy_count(self) -> int:
+        return sum(1 for r in self.replicas
+                   if r.state == "healthy" and r.thread_alive())
+
+    def serving_possible(self) -> bool:
+        """False only when NO replica could ever answer again: everything
+        alive is gone and no restart is pending — the client's wait loop
+        fails fast instead of sleeping out its deadline."""
+        if self._stop_evt.is_set():
+            return self.worker_alive()
+        for r in self.replicas:
+            if r.state == "healthy" and r.thread_alive():
+                return True
+            if r.state in ("sick", "restarting"):
+                return True
+            if r.state == "quarantined":
+                return True
+        return False
+
+    # ---------------- worker ----------------
+
+    def _worker_main(self, replica: Replica) -> None:
+        gen = replica.generation
+        try:
+            self._worker_loop(replica, gen)
+        except BaseException as e:  # thread is dying: record WHY for triage
+            if replica.generation == gen:  # zombies don't smear the fresh one
+                replica.fail_error = repr(e)
+                replica.fail_kind = (
+                    "recompile_budget"
+                    if type(e).__name__ == "RecompileBudgetExceeded"
+                    else "raised")
+
+    def _worker_loop(self, replica: Replica, gen: int) -> None:
+        while True:
+            if replica.generation == gen:
+                replica.hb.mark("idle")
+            batch = self.batcher.next_batch(timeout=self.poll_s)
+            if batch is None:
+                return  # closed and drained
+            if not batch:
+                continue  # idle tick: beat and re-wait
+            if replica.generation != gen or replica.state != "healthy":
+                # a stale generation waking up, or a replica the supervisor
+                # already declared sick, must not keep taking work — hand
+                # the batch straight back to the healthy pool
+                if not self.batcher.requeue(batch):
+                    self._reject_all(batch, "replica quarantined")
+                return
+            replica.begin_batch(batch)
+            replica.hb.mark("batch")
+            if self._chaos is not None:
+                # chaos sits OUTSIDE the per-batch guard: `raise_in_worker`
+                # must escape and kill the thread, `wedge_dispatch` freezes
+                # right here with the batch in-flight and unresolved
+                self._chaos.on_serve_batch(replica.slot, replica.hb)
+            try:
+                self.svc._run_batch(batch, replica)
+            except Exception as e:
+                self.svc._fail_batch(batch, e, replica)
+                if type(e).__name__ == "RecompileBudgetExceeded":
+                    # a budget trip is structural (shape leak / lost AOT
+                    # executables) — rebuilding the program bank is the
+                    # remedy, so the replica dies for the supervisor to
+                    # classify and restart rather than looping on it
+                    raise
+            finally:
+                replica.end_batch()
+            replica.hb.mark("idle")
+
+    def _reject_all(self, reqs: List[Any], reason: str) -> None:
+        now = self._clock()
+        won = [r for r in reqs if r.claim()]
+        for r in won:
+            observe.record_event("serve.request", status="internal_error",
+                                 latency_s=round(now - r.enqueued, 6))
+        with self.svc._lock:
+            self.svc._counts["errors"] += len(won)
+        for r in won:
+            r.deliver(ServeError(reason=reason,
+                                 latency_ms=(now - r.enqueued) * 1e3,
+                                 status="internal_error"))
+
+    # ---------------- supervisor ----------------
+
+    def _supervise(self) -> None:
+        interval = max(0.05, self.stale_after_s / 5.0)
+        while not self._stop_evt.wait(interval):
+            now = self._clock()
+            for r in self.replicas:
+                try:
+                    if r.state == "healthy":
+                        self._check_replica(r, now)
+                    elif (r.state == "quarantined"
+                            and r.restart_at is not None
+                            and now >= r.restart_at):
+                        r.state = "restarting"
+                        threading.Thread(
+                            target=self._restart, args=(r,),
+                            name=f"serve-restart-r{r.slot}",
+                            daemon=True).start()
+                except Exception as e:
+                    # the supervisor must never die to one replica's
+                    # bookkeeping; telemetry the failure and keep watching
+                    observe.record_event("serve.supervisor_error",
+                                         replica=r.slot, error=repr(e))
+
+    def _check_replica(self, r: Replica, now: float) -> None:
+        if not r.thread_alive():
+            kind = r.fail_kind or "raised"
+            self._mark_sick(r, kind, now, error=r.fail_error)
+        elif r.hb.stale_s(now) > self.stale_after_s:
+            self._mark_sick(r, "wedged", now,
+                            stale_s=round(r.hb.stale_s(now), 3))
+
+    def _mark_sick(self, r: Replica, cause: str, now: float, **info) -> None:
+        # the state transition and failover run to completion BEFORE any
+        # telemetry: a throwing event sink must never strand a replica in
+        # "sick" (a state this method owns) or lose its in-flight requests
+        r.state = "sick"
+        inflight = r.take_inflight()
+        self._failover(inflight, now)
+        r.restarts += 1
+        retire = r.restarts > int(getattr(self.cfg, "max_restarts", 0))
+        delay = 0.0
+        if not retire:
+            delay = retry_delay(
+                f"serve-r{r.slot}", r.restarts,
+                base=float(getattr(self.cfg, "restart_backoff_base", 0.5)),
+                cap=float(getattr(self.cfg, "restart_backoff_cap", 30.0)))
+            r.restart_at = now + delay
+            r.state = "quarantined"
+        observe.record_event("serve.replica.sick", replica=r.slot,
+                             generation=r.generation, cause=cause,
+                             inflight=len(inflight), **info)
+        if retire:
+            self._retire(r)
+            return
+        observe.record_event("serve.replica.quarantine", replica=r.slot,
+                             generation=r.generation, cause=cause,
+                             restarts=r.restarts,
+                             retry_in_s=round(delay, 3))
+
+    def _failover(self, inflight: List[Any], now: float) -> None:
+        """Re-dispatch a failed replica's unanswered in-flight requests to
+        the healthy replicas: at most ONE re-enqueue per request, original
+        deadline preserved (already-expired ones are shed typed right
+        here). A request whose second replica also fails resolves as an
+        internal error — never a third try, never a hang."""
+        requeue: List[Any] = []
+        for req in inflight:
+            if req.done.is_set():
+                continue
+            if req.redispatched:
+                if req.claim():
+                    with self.svc._lock:
+                        self.svc._counts["errors"] += 1
+                    observe.record_event(
+                        "serve.request", status="internal_error",
+                        latency_s=round(now - req.enqueued, 6),
+                        redispatched=True)
+                    req.deliver(ServeError(
+                        reason="replica failed twice",
+                        latency_ms=(now - req.enqueued) * 1e3,
+                        status="internal_error"))
+                continue
+            if now > req.deadline:
+                if req.claim():
+                    with self.svc._lock:
+                        self.svc._counts["deadline_exceeded"] += 1
+                    observe.record_event(
+                        "serve.request", status="deadline_exceeded",
+                        latency_s=round(now - req.enqueued, 6), shed=True)
+                    req.deliver(DeadlineExceeded(
+                        latency_ms=(now - req.enqueued) * 1e3,
+                        deadline_ms=req.budget_s() * 1e3))
+                continue
+            req.redispatched = True
+            requeue.append(req)
+        if requeue:
+            with self._lock:
+                self.redispatched += len(requeue)
+            if not self.batcher.requeue(requeue):
+                self._reject_all(requeue, "service stopping")
+
+    def _retire(self, r: Replica) -> None:
+        r.state = "retired"
+        r.restart_at = None
+        healthy = max(self.healthy_count(), 0)
+        total = len(self.replicas)
+        retired = sum(1 for x in self.replicas if x.state == "retired")
+        live = total - retired
+        new_depth = (max(1, self._base_depth * live // total)
+                     if live else 0)
+        self.batcher.set_max_queue_depth(new_depth)
+        observe.record_event("serve.replica.retire", replica=r.slot,
+                             generation=r.generation, restarts=r.restarts,
+                             healthy_left=healthy,
+                             max_queue_depth=new_depth)
+        if live == 0:
+            # terminal degradation: nothing will ever serve again — answer
+            # every queued waiter with a typed error instead of hanging
+            self._reject_all(self.batcher.drain(), "no healthy replicas")
+
+    def _restart(self, r: Replica) -> None:
+        t0 = self._clock()
+        try:
+            clean, defenses, aot_stats = self.svc._build_bank(r.slot)
+        except Exception as e:
+            observe.record_event("serve.replica.quarantine", replica=r.slot,
+                                 generation=r.generation,
+                                 cause="restart_failed", error=repr(e),
+                                 restarts=r.restarts)
+            r.restarts += 1
+            if r.restarts > int(getattr(self.cfg, "max_restarts", 0)):
+                self._retire(r)
+            else:
+                delay = retry_delay(
+                    f"serve-r{r.slot}", r.restarts,
+                    base=float(getattr(self.cfg, "restart_backoff_base",
+                                       0.5)),
+                    cap=float(getattr(self.cfg, "restart_backoff_cap",
+                                      30.0)))
+                r.restart_at = self._clock() + delay
+                r.state = "quarantined"
+            return
+        r.generation += 1
+        r.clean, r.defenses = clean, defenses
+        r.aot_stats = aot_stats
+        r.hb = ReplicaHeartbeat(self._hb_path(r.slot), r.slot, self._clock)
+        r.fail_kind = r.fail_error = None
+        if r.slot == 0:
+            # replica 0's bank IS the service's bank: trace_entrypoints,
+            # trace_counts, and the defenses attribute must reflect the
+            # programs that are actually serving
+            self.svc._clean, self.svc.defenses = clean, defenses
+        r.state = "healthy"
+        self._launch(r)
+        observe.record_event(
+            "serve.replica.restart", replica=r.slot,
+            generation=r.generation, restarts=r.restarts,
+            dur_s=round(self._clock() - t0, 6),
+            aot_hits=(aot_stats or {}).get("hits"),
+            aot_misses=(aot_stats or {}).get("misses"),
+            trace_counts=sum(
+                self.svc._bank_trace_counts(clean, defenses).values()))
+
+    # ---------------- stats ----------------
+
+    def snapshot(self) -> List[dict]:
+        now = self._clock()
+        out = []
+        for r in self.replicas:
+            lats = sorted(r.latencies_ms[-8192:])
+
+            def pct(q, lats=lats):
+                v = observe.nearest_rank_percentile(lats, q)
+                return None if v is None else round(v, 3)
+
+            out.append({
+                "replica": r.slot,
+                "state": r.state,
+                "generation": r.generation,
+                "restarts": r.restarts,
+                "thread_alive": r.thread_alive(),
+                "last_phase": r.hb.last_phase,
+                "stale_s": round(r.hb.stale_s(now), 3),
+                "batches": r.batches,
+                "completed": r.completed,
+                "duplicates_shed": r.duplicates_shed,
+                "occupancy": (round(r.batch_images / r.batch_slots, 4)
+                              if r.batch_slots else 0.0),
+                "latency_ms": {"p50": pct(0.50), "p95": pct(0.95)},
+                "trace_counts": sum(self.svc._bank_trace_counts(
+                    r.clean, r.defenses).values()),
+            })
+        return out

@@ -7,12 +7,15 @@ Request lifecycle:
    method) validates the image, stamps its deadline, and submits it to the
    `MicroBatcher`'s bounded queue — or returns a typed `Overloaded` reject
    when the queue is at depth (backpressure, never unbounded queueing).
-2. The single worker thread pops a batch on a size-or-deadline trigger,
-   pads it up to the nearest shape bucket (`data.pad_to_bucket` /
-   `data.batch_buckets`), and drives the jitted programs: one undefended
-   forward plus the full PatchCleanser defense bank. Every program was
-   compiled for every bucket at startup warmup and is registered with the
-   PR 2 recompile watchdog (`timed_first_call(..., recompile_budget=
+2. A replica worker thread (one of `serve_cfg.replicas`, all sharing the
+   one queue — see `serve/pool.py` for the supervisor, health, and
+   failover story) pops a batch on a size-or-deadline trigger, pads it up
+   to the nearest shape bucket (`data.pad_to_bucket` /
+   `data.batch_buckets`), and drives ITS OWN jitted programs: one
+   undefended forward plus the full PatchCleanser defense bank, built from
+   a per-replica closure so trace caches stay independent. Every program
+   was compiled for every bucket at startup warmup and is registered with
+   the PR 2 recompile watchdog (`timed_first_call(..., recompile_budget=
    n_buckets)`), so live traffic NEVER retraces — a shape leak raises
    `RecompileBudgetExceeded` instead of silently turning the service into
    a compile loop.
@@ -47,6 +50,7 @@ from dorpatch_tpu import observe
 from dorpatch_tpu.config import DefenseConfig, ExperimentConfig, ServeConfig
 from dorpatch_tpu.defense import build_defenses
 from dorpatch_tpu.serve.batcher import MicroBatcher, PendingRequest
+from dorpatch_tpu.serve.pool import ReplicaPool
 from dorpatch_tpu.serve.types import (
     DeadlineExceeded,
     Overloaded,
@@ -154,11 +158,20 @@ class CertifiedInferenceService:
                                     serve_cfg.flush_fraction, clock=clock)
         # one clean-forward program + one certifier per radius, each allowed
         # exactly one trace per shape bucket — warmup compiles them all, so
-        # live traffic runs at _cache_size() == n_buckets forever
+        # live traffic runs at _cache_size() == n_buckets forever. The bank
+        # wraps a FRESH closure, not `apply_fn` itself: jax.jit shares its
+        # trace cache across wrappers of the same function object, so two
+        # services over one victim function would otherwise pool their
+        # trace counts and trip each other's recompile budgets (replica
+        # banks get the same isolation in `_build_bank`)
+        def _bank_apply(p, x, _apply=apply_fn):
+            return _apply(p, x)
+
         self._clean = observe.timed_first_call(
-            jax.jit(apply_fn), "serve.clean_predict",
+            jax.jit(_bank_apply), "serve.clean_predict",
             recompile_budget=n_buckets)
-        self.defenses = build_defenses(apply_fn, img_size, defense_cfg,
+        self._incremental_engine = incremental_engine
+        self.defenses = build_defenses(_bank_apply, img_size, defense_cfg,
                                        recompile_budget=n_buckets,
                                        incremental=incremental_engine)
         self.ratios = tuple(defense_cfg.ratios)
@@ -185,7 +198,7 @@ class CertifiedInferenceService:
                         "certify_forward_equivalents": 0.0,
                         "certify_forwards_exhaustive": 0}
         self._latencies_ms: List[float] = []
-        self._worker: Optional[threading.Thread] = None
+        self._pool: Optional[ReplicaPool] = None
         self._stack: Optional[contextlib.ExitStack] = None
         self._elog: Optional[observe.EventLog] = None
         self._warm = False
@@ -215,7 +228,7 @@ class CertifiedInferenceService:
     # ---------------- lifecycle ----------------
 
     def start(self) -> "CertifiedInferenceService":
-        if self._worker is not None:
+        if self._pool is not None:
             raise RuntimeError("service already started")
         self._stack = contextlib.ExitStack()
         try:
@@ -224,6 +237,10 @@ class CertifiedInferenceService:
             # a failed start (warmup OOM, budget trip) must unwind every
             # global it installed: active EventLog, run span, recompile
             # guard — otherwise the NEXT run in this process inherits them
+            if self._pool is not None:
+                self._pool.begin_stop()
+                self.batcher.close()
+                self._pool = None
             self._stack.close()
             self._stack = None
             self._elog = None
@@ -281,26 +298,55 @@ class CertifiedInferenceService:
             "serve.started", buckets=list(self.bucket_sizes),
             ratios=[float(r) for r in self.ratios],
             max_queue_depth=self.batcher.max_queue_depth,
-            deadline_ms=float(self.serve_cfg.deadline_ms))
-        self._worker = threading.Thread(target=self._worker_loop,
-                                        name="serve-worker", daemon=True)
-        self._worker.start()
+            deadline_ms=float(self.serve_cfg.deadline_ms),
+            replicas=max(1, int(getattr(self.serve_cfg, "replicas", 1))))
+        chaos = None
+        if getattr(self.serve_cfg, "chaos", ""):
+            # serve-side fault injection (shared harness with the farm):
+            # the state dir holds the O_EXCL fired-markers, so each fault
+            # fires exactly once per service run
+            import tempfile
+
+            from dorpatch_tpu.chaos import Chaos, parse_faults
+
+            state_dir = self.result_dir or tempfile.mkdtemp(
+                prefix="dorpatch_serve_chaos_")
+            chaos = Chaos(parse_faults(self.serve_cfg.chaos),
+                          job_id="serve", state_dir=state_dir,
+                          crash_mode="raise")
+        # the pool builds replicas 1..N-1 (fresh per-replica program banks,
+        # AOT-booted and warmed through _build_bank), adopts replica 0's
+        # bank from this service, launches every worker loop, and starts
+        # the supervisor
+        self._pool = ReplicaPool(self, chaos=chaos)
+        self._pool.start()
+
+    def _drain_timeout_s(self) -> float:
+        """How long stop() waits for in-flight work: twice the request
+        deadline (a draining batch can hold a full deadline of queue wait
+        plus the batched forward itself), floored so sub-second test
+        deadlines still tolerate a slow compile straggler."""
+        return max(2.0 * float(self.serve_cfg.deadline_ms) / 1e3, 5.0)
 
     def stop(self) -> None:
-        if self._worker is None:
+        if self._pool is None:
             return
+        self._pool.begin_stop()
         self.batcher.close()
-        self._worker.join(timeout=60.0)
-        if self._worker.is_alive():
-            # a wedged device call: keep the worker reference (so waiting
+        drain_s = self._drain_timeout_s()
+        if not self._pool.join(drain_s):
+            # a wedged device call: keep the pool reference (so waiting
             # clients don't misreport a dead worker) and leave the
-            # EventLog open for its late writes; the daemon thread dies
+            # EventLog open for its late writes; the daemon threads die
             # with the process. A later stop() retries the join.
-            observe.record_event("serve.stop_timeout")
-            observe.log("WARNING: serve worker still draining after 60s; "
-                        "telemetry stays open", file=sys.stderr)
+            observe.record_event("serve.drain_timeout",
+                                 timeout_s=round(drain_s, 3),
+                                 replicas=self._pool.still_draining())
+            observe.log(f"WARNING: serve workers still draining after "
+                        f"{drain_s:.1f}s; telemetry stays open",
+                        file=sys.stderr)
             return
-        self._worker = None
+        self._pool = None
         observe.record_event("serve.stopped", **self._snapshot())
         if self._stack is not None:
             self._stack.close()
@@ -327,46 +373,94 @@ class CertifiedInferenceService:
         traffic decides per batch which verdict classes — and therefore
         which ragged second-round shapes — occur, and all of them must
         already be compiled."""
+        self._warm_bank(self._clean, self.defenses, replica=0)
+        self._warm = True
+        return self.trace_counts()
+
+    def _warm_bank(self, clean, defenses, replica: int = 0) -> None:
+        """Warm ONE replica's program bank (see `warmup`); replica 0's bank
+        is the service's own, the pool warms the others through here."""
         for b in self.bucket_sizes:
             t0 = self._clock()
             dummy = np.full((b, self.img_size, self.img_size, 3), 0.5,
                             np.float32)
             if self.prune == "off":
-                logits, per_defense = self._dispatch(jax.device_put(dummy), b)
+                logits, per_defense = self._dispatch(
+                    jax.device_put(dummy), b, clean=clean, defenses=defenses)
             else:
-                logits, per_defense = self._clean(self.params,
-                                                  jax.device_put(dummy)), []
+                logits, per_defense = clean(self.params,
+                                            jax.device_put(dummy)), []
             # marshalling doubles as the completion sync for the warmup call
             marshal_response([], logits, per_defense, self.ratios, b,
                              clock=self._clock)
             observe.record_event("serve.warmup", bucket=int(b),
+                                 replica=int(replica),
                                  dur_s=round(self._clock() - t0, 6))
         if self.prune != "off":
             t0 = self._clock()
-            for d in self.defenses:
+            for d in defenses:
                 d.warm_pruned(self.params, self.bucket_sizes,
                               num_classes=self.num_classes)
             observe.record_event("serve.warmup_pruned",
                                  incremental=self.incremental,
+                                 replica=int(replica),
                                  row_buckets=[int(w) for w in
-                                              self.defenses[0].row_bucket_sizes],
+                                              defenses[0].row_bucket_sizes],
                                  dur_s=round(self._clock() - t0, 6))
-        self._warm = True
-        return self.trace_counts()
+
+    def _build_bank(self, slot: int):
+        """Build one replica's complete program bank from a FRESH closure
+        over `apply_fn` — jit caches live on the wrapper object, so a fresh
+        closure per replica keeps every replica's trace caches (and
+        therefore its warmup, AOT boot, and recompile budgets) fully
+        independent. AOT-boots from the executable store when configured
+        (the store is keyed on program name + interface + signature, so all
+        replicas share the same entries — a restart after the first boot is
+        all hits, i.e. zero traces), then warms. Returns
+        `(clean, defenses, aot_stats)`."""
+        apply_fn = self.apply_fn
+
+        def replica_apply(p, x, _apply=apply_fn):
+            return _apply(p, x)
+
+        n_buckets = len(self.bucket_sizes)
+        clean = observe.timed_first_call(
+            jax.jit(replica_apply), "serve.clean_predict",
+            recompile_budget=n_buckets)
+        defenses = build_defenses(replica_apply, self.img_size,
+                                  self.defense_cfg,
+                                  recompile_budget=n_buckets,
+                                  incremental=self._incremental_engine)
+        aot_stats = None
+        if (self.aot_cfg is not None
+                and getattr(self.aot_cfg, "mode", "off") != "off"
+                and getattr(self.aot_cfg, "cache_dir", "")):
+            from dorpatch_tpu.aot.boot import warm_boot
+
+            aot_stats = warm_boot(self._bank_entrypoints(clean, defenses),
+                                  self.aot_cfg, clock=self._clock)
+        if self.serve_cfg.warmup:
+            self._warm_bank(clean, defenses, replica=slot)
+        return clean, defenses, aot_stats
 
     def trace_entrypoints(self) -> List[tuple]:
         """`(name, program, abstract example args)` for every serving
         program at every shape bucket — the program auditor's enumeration
         hook (`analysis/entrypoints.py`). Bucket-suffixed names (e.g.
         `serve.clean_predict[b8]`) keep one registry entry per compiled
-        shape bucket; nothing is executed."""
+        shape bucket; nothing is executed. Always replica 0's bank — every
+        replica runs the same programs with the same names, so the
+        registry, baseline, and AOT store see ONE program set."""
+        return self._bank_entrypoints(self._clean, self.defenses)
+
+    def _bank_entrypoints(self, clean, defenses) -> List[tuple]:
         out: List[tuple] = []
         for b in self.bucket_sizes:
             imgs = jax.ShapeDtypeStruct(
                 (b, self.img_size, self.img_size, 3), np.dtype(np.float32))
-            out.append((f"serve.clean_predict[b{b}]", self._clean,
+            out.append((f"serve.clean_predict[b{b}]", clean,
                         (self.params, imgs)))
-            for d in self.defenses:
+            for d in defenses:
                 r = d.spec.patch_ratio
                 out.append((f"defense.predict.r{r}[b{b}]", d._predict,
                             (self.params, imgs, self.num_classes)))
@@ -378,7 +472,7 @@ class CertifiedInferenceService:
                             out.append((f"{name}[b{b}]", fn,
                                         (self.params, imgs)))
         if self.prune != "off":
-            for d in self.defenses:
+            for d in defenses:
                 for name, fn, kind in d.pruned_programs():
                     if kind not in ("rows", "rows_sets"):
                         continue
@@ -399,9 +493,13 @@ class CertifiedInferenceService:
         far). After warmup the clean forward (and, pruned: phase 1 + pair
         audit) sit at `len(bucket_sizes)` and the row program at
         `len(row_bucket_sizes)`; the serve e2e asserts this dict is
-        IDENTICAL before and after traffic."""
-        out = {"serve.clean_predict": int(self._clean._cache_size())}
-        for d in self.defenses:
+        IDENTICAL before and after traffic. Reads replica 0's live bank;
+        per-replica totals are in `stats()["replicas"]`."""
+        return self._bank_trace_counts(self._clean, self.defenses)
+
+    def _bank_trace_counts(self, clean, defenses) -> Dict[str, int]:
+        out = {"serve.clean_predict": int(clean._cache_size())}
+        for d in defenses:
             name = f"defense.predict.r{d.spec.patch_ratio}"
             out[name] = int(d._predict._cache_size())
             if self.prune != "off":
@@ -462,29 +560,61 @@ class CertifiedInferenceService:
         with self._lock:
             self._counts["received"] += 1
         # every admitted request IS resolved (the worker sheds expired ones
-        # with DeadlineExceeded), so wait for the answer and poll only for
-        # the one failure the queue cannot explain: a dead worker thread.
-        # A fixed timeout here would misfire on a backlogged-but-healthy
-        # worker and double-count the request once the worker answers.
-        while not req.done.wait(timeout=5.0):
-            w = self._worker
-            if (w is None or not w.is_alive()) and not req.done.is_set():
-                with self._lock:
-                    self._counts["errors"] += 1
-                return ServeError(reason="worker thread died",
-                                  status="internal_error")
+        # with DeadlineExceeded, the supervisor re-dispatches a failed
+        # replica's in-flight work), so wait for the answer and poll only
+        # for the one failure the pool cannot recover from: no replica left
+        # that could ever serve again. A fixed timeout here would misfire
+        # on a backlogged-but-healthy pool and double-count the request
+        # once a worker answers; the claim() arbitration keeps this path
+        # and a racing resolver from ever double-answering. The
+        # deadline+grace backstop exists for the failure NOBODY resolves
+        # (a request dropped by a bug in the failover bookkeeping): a
+        # worker would have shed it typed at the deadline, so waiting out
+        # the deadline plus the supervisor's whole detection window means
+        # it is lost — abandon typed rather than hang the client.
+        while not req.done.wait(timeout=1.0):
+            pool = self._pool
+            if pool is None or not pool.serving_possible():
+                if req.claim():
+                    with self._lock:
+                        self._counts["errors"] += 1
+                    req.deliver(ServeError(reason="worker thread died",
+                                           status="internal_error"))
+                    return req.result
+            elif self._clock() > req.deadline + max(
+                    2.0 * pool.stale_after_s, 5.0):
+                if req.claim():
+                    now2 = self._clock()
+                    with self._lock:
+                        self._counts["deadline_exceeded"] += 1
+                    observe.record_event(
+                        "serve.request", status="deadline_exceeded",
+                        latency_s=round(now2 - req.enqueued, 6),
+                        abandoned=True)
+                    req.deliver(DeadlineExceeded(
+                        latency_ms=(now2 - req.enqueued) * 1e3,
+                        deadline_ms=req.budget_s() * 1e3))
+                    return req.result
         return req.result
 
     def healthz(self) -> dict:
-        """Liveness the load balancer can act on: "ok" only while the
-        worker thread is actually serving (the front-end maps anything
-        else to 503, so a dead-worker instance drains instead of burning
-        every routed request's poll interval)."""
-        w = self._worker
-        alive = w is not None and w.is_alive()
-        return {"status": "ok" if alive else "unhealthy",
-                "worker_alive": alive, "warm": self._warm,
-                "queue_depth": self.batcher.qsize()}
+        """Liveness the load balancer can act on: "ok" only while at least
+        one healthy replica thread is actually serving (the front-end maps
+        anything else to 503, so a dead-pool instance drains instead of
+        burning every routed request's poll interval). `worker_alive` stays
+        the single-worker-era name: any replica thread alive."""
+        pool = self._pool
+        alive = pool is not None and pool.worker_alive()
+        healthy = pool.healthy_count() if pool is not None else 0
+        out = {"status": "ok" if healthy > 0 else "unhealthy",
+               "worker_alive": alive, "warm": self._warm,
+               "queue_depth": self.batcher.qsize()}
+        if pool is not None:
+            out["replicas"] = {
+                "total": len(pool.replicas), "healthy": healthy,
+                "retired": sum(1 for r in pool.replicas
+                               if r.state == "retired")}
+        return out
 
     def stats(self) -> dict:
         s = self._snapshot()
@@ -496,6 +626,11 @@ class CertifiedInferenceService:
             s["aot"] = self._aot_stats
         if self._started_at is not None:
             s["uptime_s"] = round(self._clock() - self._started_at, 3)
+        pool = self._pool
+        if pool is not None:
+            s["replicas"] = pool.snapshot()
+            s["failover"] = {"redispatched": pool.redispatched,
+                             "duplicates_shed": pool.duplicates_shed}
         return s
 
     def _snapshot(self) -> dict:
@@ -539,7 +674,7 @@ class CertifiedInferenceService:
 
     # ---------------- worker ----------------
 
-    def _dispatch(self, x, n_real: int):
+    def _dispatch(self, x, n_real: int, clean=None, defenses=None):
         """Launch the clean forward and EVERY certifier before materializing
         any result, so the programs overlap on device instead of serializing
         on per-radius host transfers. Exhaustive mode is dispatch-only (the
@@ -549,48 +684,61 @@ class CertifiedInferenceService:
         path's one designed sync, inside defense.py) and dispatch only the
         phase-2 work the batch's verdicts actually need — on benign,
         first-round-unanimous traffic that is the 630-pair audit alone, and
-        under "consensus" nothing at all."""
-        logits = self._clean(self.params, x)
+        under "consensus" nothing at all. `clean`/`defenses` select a
+        replica's bank; default is replica 0's (the service's own)."""
+        clean = self._clean if clean is None else clean
+        defenses = self.defenses if defenses is None else defenses
+        logits = clean(self.params, x)
         if self.prune == "off":
             per_defense = [d.predict_tables(self.params, x, self.num_classes)
-                           for d in self.defenses]
+                           for d in defenses]
             return logits, per_defense
         pendings = [d.begin_pruned(self.params, x, self.num_classes,
                                    n=n_real, bucket_sizes=self.bucket_sizes)
-                    for d in self.defenses]
+                    for d in defenses]
         for p in pendings:
             p.schedule()
         return logits, pendings
 
-    def _worker_loop(self) -> None:
-        while True:
-            batch = self.batcher.next_batch()
-            if batch is None:
-                return
-            try:
-                self._run_batch(batch)
-            except Exception as e:
-                # a failed batch must resolve its waiters (and stay
-                # serving), not kill the worker thread; events and counts
-                # land before the waiters wake, as on the success path.
-                # Requests _run_batch already answered (shed as expired
-                # before dispatch) are NOT re-resolved or re-counted.
-                now = self._clock()
-                pending = [r for r in batch if not r.done.is_set()]
-                for r in pending:
-                    observe.record_event(
-                        "serve.request", status="internal_error",
-                        latency_s=round(now - r.enqueued, 6))
-                with self._lock:
-                    self._counts["errors"] += len(pending)
-                observe.record_event("serve.batch_error", error=repr(e),
-                                     images=len(pending))
-                for r in pending:
-                    r.resolve(ServeError(reason=repr(e),
-                                         latency_ms=(now - r.enqueued) * 1e3,
-                                         status="internal_error"))
+    def _note_duplicate(self, replica=None) -> None:
+        """A resolver lost the claim race: the request was already answered
+        elsewhere (failover re-dispatch landed first, or vice versa). The
+        late answer is shed, counted, and never delivered."""
+        pool = self._pool
+        if pool is not None:
+            with pool._lock:
+                pool.duplicates_shed += 1
+        if replica is not None:
+            replica.duplicates_shed += 1
 
-    def _run_batch(self, reqs: List[PendingRequest]) -> None:
+    def _fail_batch(self, batch: List[PendingRequest], e: Exception,
+                    replica=None) -> None:
+        """A failed batch must resolve its unanswered waiters (the worker
+        stays serving for ordinary errors — the pool escalates only the
+        structural ones); events and counts land before the waiters wake,
+        as on the success path. Requests already answered (shed as expired
+        before dispatch, or won by a failover resolver) are skipped via
+        the claim arbiter, never re-resolved or re-counted."""
+        now = self._clock()
+        pending = [r for r in batch if r.claim()]
+        for r in pending:
+            observe.record_event(
+                "serve.request", status="internal_error",
+                latency_s=round(now - r.enqueued, 6))
+        with self._lock:
+            self._counts["errors"] += len(pending)
+        observe.record_event(
+            "serve.batch_error", error=repr(e), images=len(pending),
+            replica=replica.slot if replica is not None else 0)
+        for r in pending:
+            r.deliver(ServeError(reason=repr(e),
+                                 latency_ms=(now - r.enqueued) * 1e3,
+                                 status="internal_error"))
+
+    def _run_batch(self, reqs: List[PendingRequest], replica=None) -> None:
+        clean = self._clean if replica is None else replica.clean
+        defenses = self.defenses if replica is None else replica.defenses
+        slot = 0 if replica is None else replica.slot
         # shed already-expired requests BEFORE dispatch: under sustained
         # overload the deadline contract forces their answers to be
         # withheld anyway, so spending a certify sweep on them would drive
@@ -599,15 +747,17 @@ class CertifiedInferenceService:
         live = [r for r in reqs if now <= r.deadline]
         expired = [r for r in reqs if now > r.deadline]
         if expired:
-            for r in expired:
+            won = [r for r in expired if r.claim()]
+            self._note_duplicates(len(expired) - len(won), replica)
+            for r in won:
                 observe.record_event("serve.request",
                                      status="deadline_exceeded",
                                      latency_s=round(now - r.enqueued, 6),
                                      shed=True)
             with self._lock:
-                self._counts["deadline_exceeded"] += len(expired)
-            for r in expired:
-                r.resolve(DeadlineExceeded(
+                self._counts["deadline_exceeded"] += len(won)
+            for r in won:
+                r.deliver(DeadlineExceeded(
                     latency_ms=(now - r.enqueued) * 1e3,
                     deadline_ms=r.budget_s() * 1e3))
         if not live:
@@ -616,22 +766,31 @@ class CertifiedInferenceService:
         n = len(reqs)
         bucket = data_lib.bucket_batch(n, self.bucket_sizes)
         with observe.span("serve.batch", bucket=int(bucket), images=n,
+                          replica=slot,
                           queue_depth=self.batcher.qsize()) as sp:
             # pad on the host so exactly ONE host->device transfer
             # happens per batch, always bucket-shaped
             imgs = data_lib.pad_to_bucket(np.stack([r.image for r in reqs]),
                                           bucket)
-            logits, per_defense = self._dispatch(jax.device_put(imgs), n)
+            logits, per_defense = self._dispatch(jax.device_put(imgs), n,
+                                                 clean=clean,
+                                                 defenses=defenses)
             responses = marshal_response(reqs, logits, per_defense,
                                          self.ratios, bucket,
                                          clock=self._clock)
             # stats and telemetry land BEFORE the waiters wake: a client
             # that returns from predict() must observe its own completion
-            # in stats()
+            # in stats(). claim() first: a request the failover path
+            # already answered is a shed duplicate, not a second answer.
             ok = 0
+            deliver: List[tuple] = []
             exhaustive = sum(d.num_forwards_exhaustive
-                             for d in self.defenses)
+                             for d in defenses)
             for r, resp in zip(reqs, responses):
+                if not r.claim():
+                    self._note_duplicate(replica)
+                    continue
+                deliver.append((r, resp))
                 status = resp.status
                 lat = getattr(resp, "latency_ms", None)
                 fwd = getattr(resp, "certify_forwards", None)
@@ -670,6 +829,20 @@ class CertifiedInferenceService:
                 self._counts["batches"] += 1
                 self._counts["batch_images"] += n
                 self._counts["batch_slots"] += bucket
+            if replica is not None:
+                replica.batches += 1
+                replica.batch_images += n
+                replica.batch_slots += bucket
+                replica.completed += ok
+                replica.latencies_ms.extend(
+                    resp.latency_ms for _r, resp in deliver
+                    if resp.status == "ok")
+                if len(replica.latencies_ms) > 8192:
+                    del replica.latencies_ms[:4096]
             sp["ok"] = ok
-            for r, resp in zip(reqs, responses):
-                r.resolve(resp)
+            for r, resp in deliver:
+                r.deliver(resp)
+
+    def _note_duplicates(self, count: int, replica=None) -> None:
+        for _ in range(count):
+            self._note_duplicate(replica)

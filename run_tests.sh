@@ -105,3 +105,16 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   | grep -q '"ok": true' \
   || { echo "aot smoke: warm-boot/zero-trace violation"; exit 1; }
 echo "aot smoke: OK"
+# Smoke: supervised replica serving — a 2-replica service boots strictly
+# from an AOT store, chaos wedges replica 0 mid-batch under load, and the
+# failover contract must hold: every request answered ok exactly once with
+# verdicts bit-identical to a 1-replica unfaulted control, the wedged
+# replica quarantined and restarted through the store with ZERO traces
+# under the armed watchdog, and the report rendering `-- replicas --`
+# (tools/serve_chaos_smoke.py exits non-zero and lists the violations
+# otherwise).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/serve_chaos_smoke.py \
+  | grep -q '"ok": true' \
+  || { echo "serve chaos smoke: failover/restart violation"; exit 1; }
+echo "serve chaos smoke: OK"

@@ -471,6 +471,211 @@ def test_http_front_end(tmp_path):
         assert json.loads(ei.value.read())["status"] == "error"
 
 
+# ---------- supervised replica pool (pool.py) ----------
+
+def test_retry_delay_shared_and_deterministic():
+    """One backoff formula for farm retries, replica restarts, and the
+    loadgen 503 loop: deterministic per (id, attempt), doubling, capped."""
+    from dorpatch_tpu.backoff import retry_delay
+    from dorpatch_tpu.farm.queue import retry_delay as farm_retry_delay
+
+    assert farm_retry_delay is retry_delay  # the farm re-exports, not forks
+    a = retry_delay("serve-r0", 1, base=0.5, cap=30.0)
+    assert a == retry_delay("serve-r0", 1, base=0.5, cap=30.0)
+    assert 0.5 <= a <= 0.5 * 1.25
+    assert retry_delay("serve-r0", 2, base=0.5, cap=30.0) >= a
+    assert retry_delay("serve-r0", 50, base=0.5, cap=30.0) <= 30.0 * 1.25
+    assert retry_delay("x", 1, base=0.5, cap=30.0) != \
+        retry_delay("y", 1, base=0.5, cap=30.0)  # id-seeded jitter
+
+
+def test_pending_request_claim_exactly_once():
+    """The failover arbiter: N racing resolvers, exactly one wins."""
+    req = _req(budget_s=10.0)
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def racer(i):
+        barrier.wait()
+        if req.resolve(i):
+            wins.append(i)
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+    assert req.done.is_set() and req.result == wins[0]
+    assert not req.claim()  # late duplicate: shed, never double-answered
+
+
+def test_batcher_requeue_front_and_idle_tick():
+    b = MicroBatcher((1, 2, 4), max_queue_depth=2)
+    # idle tick: with a timeout, an empty queue yields [] (the worker's
+    # heartbeat cadence), not a block
+    t0 = time.perf_counter()
+    assert b.next_batch(timeout=0.1) == []
+    assert 0.05 < time.perf_counter() - t0 < 2.0
+    old = [_req(budget_s=30.0), _req(budget_s=30.0)]
+    new = [_req(budget_s=30.0), _req(budget_s=30.0)]
+    for r in new:
+        assert b.submit(r)
+    # failover requeue: jumps the FIFO (those requests already burned
+    # queue time) in original order, exempt from the depth bound
+    assert b.requeue(old)
+    assert b.qsize() == 4
+    assert b.next_batch() == old + new
+    b.close()
+    assert not b.requeue(old)  # closed: caller resolves them as errors
+
+
+def test_wedged_replica_fails_over_restarts_and_reports(tmp_path, capsys):
+    """The tentpole drill: 2 replicas, chaos wedges replica 0 mid-batch
+    with requests in flight. Every admitted request is answered ok exactly
+    once (failover re-dispatch inside the original deadline, duplicates
+    shed not double-answered); the supervisor classifies the wedge via
+    missed beats, quarantines, and restarts through a fresh program bank;
+    the report renders the `-- replicas --` accounting."""
+    svc = make_service(tmp_path, max_batch=2, bucket_sizes=(1, 2),
+                       deadline_ms=10000.0, replicas=2, max_restarts=2,
+                       restart_backoff_base=0.2, restart_backoff_cap=1.0,
+                       replica_stale_s=0.4, chaos="wedge_dispatch")
+    images = make_images(6, seed=9)
+    with svc:
+        results = _fire(svc, images, concurrency=6)
+        assert all(isinstance(r, PredictResult) for r in results), \
+            [getattr(r, "status", r) for r in results]
+        st = svc.stats()
+        assert st["failover"]["redispatched"] >= 1
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            snap = {r["replica"]: r for r in svc.stats()["replicas"]}
+            if snap[0]["state"] == "healthy" and snap[0]["generation"] == 1:
+                break
+            time.sleep(0.2)
+        snap = {r["replica"]: r for r in svc.stats()["replicas"]}
+        assert snap[0]["state"] == "healthy" and snap[0]["generation"] == 1
+        assert snap[0]["restarts"] == 1
+        assert snap[1]["state"] == "healthy" and snap[1]["restarts"] == 0
+        assert snap[1]["completed"] == 6  # the healthy replica took it all
+        h = svc.healthz()
+        assert h["status"] == "ok" and h["replicas"]["healthy"] == 2
+        post = svc.predict(images[0], deadline_ms=10000.0)
+        assert isinstance(post, PredictResult)
+    assert svc.stats()["completed"] == 7
+
+    rd = str(tmp_path / "serve")
+    events = [json.loads(line) for line in open(f"{rd}/events.jsonl")]
+    names = [e.get("name") for e in events]
+    sick = [e for e in events if e.get("name") == "serve.replica.sick"]
+    assert sick and sick[0]["cause"] == "wedged" and sick[0]["replica"] == 0
+    assert "serve.replica.quarantine" in names
+    assert "serve.replica.restart" in names
+    assert report.main([rd]) == 0
+    out = capsys.readouterr().out
+    assert "-- replicas --" in out
+    assert "1 restart(s)" in out
+    assert report.main([rd, "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["replicas"]["restarts"] == 1 and s["replicas"]["retired"] == 0
+    assert s["replicas"]["failed_over"] >= 1
+
+
+def test_raised_worker_restarts_and_redispatches(tmp_path):
+    """chaos `raise_in_worker` kills the only replica's thread with a
+    request in flight: the supervisor classifies it `raised`, re-dispatches
+    the request, restarts the replica after backoff, and the SAME request
+    is answered ok by the fresh generation — at most one re-dispatch."""
+    svc = make_service(tmp_path, max_batch=1, bucket_sizes=(1,),
+                       deadline_ms=30000.0, replicas=1, max_restarts=2,
+                       restart_backoff_base=0.2, restart_backoff_cap=1.0,
+                       replica_stale_s=0.4, chaos="raise_in_worker")
+    with svc:
+        r = svc.predict(make_images(1)[0], deadline_ms=30000.0)
+        assert isinstance(r, PredictResult), getattr(r, "reason", r)
+        st = svc.stats()
+        assert st["failover"]["redispatched"] == 1
+        snap = st["replicas"][0]
+        assert snap["generation"] == 1 and snap["restarts"] == 1
+    events = [json.loads(line)
+              for line in open(f"{tmp_path}/serve/events.jsonl")]
+    sick = [e for e in events if e.get("name") == "serve.replica.sick"]
+    assert sick[0]["cause"] == "raised" and sick[0]["inflight"] == 1
+
+
+def test_wedge_heartbeat_detected_while_thread_lives(tmp_path):
+    """chaos `wedge_heartbeat` freezes only the BEATS: the thread keeps
+    serving, yet the supervisor must still declare it wedged (missed-beat
+    staleness, not thread liveness) and cycle it through a restart."""
+    svc = make_service(tmp_path, max_batch=1, bucket_sizes=(1,),
+                       deadline_ms=15000.0, replicas=1, max_restarts=2,
+                       restart_backoff_base=0.2, restart_backoff_cap=1.0,
+                       replica_stale_s=0.4, chaos="wedge_heartbeat")
+    with svc:
+        first = svc.predict(make_images(1)[0], deadline_ms=15000.0)
+        assert isinstance(first, PredictResult)  # frozen beats still serve
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            snap = svc.stats()["replicas"][0]
+            if snap["state"] == "healthy" and snap["generation"] == 1:
+                break
+            time.sleep(0.2)
+        snap = svc.stats()["replicas"][0]
+        assert snap["generation"] == 1 and snap["restarts"] == 1
+        second = svc.predict(make_images(1)[0], deadline_ms=15000.0)
+        assert isinstance(second, PredictResult)
+    events = [json.loads(line)
+              for line in open(f"{tmp_path}/serve/events.jsonl")]
+    sick = [e for e in events if e.get("name") == "serve.replica.sick"]
+    assert sick[0]["cause"] == "wedged"
+
+
+def test_exhausted_restarts_retire_degrade_not_hang(tmp_path):
+    """A replica past max_restarts retires; with nothing left the pool
+    degrades: admission shrinks to zero (`Overloaded` immediately), the
+    queue drains typed, and nothing ever hangs (satellite: dead worker)."""
+    svc = make_service(tmp_path, max_batch=1, bucket_sizes=(1,),
+                       deadline_ms=10000.0, replicas=1, max_restarts=0,
+                       replica_stale_s=0.3, chaos="raise_in_worker")
+    with svc, HttpFrontend(svc, port=0) as fe:
+        base = f"http://127.0.0.1:{fe.port}"
+        body = json.dumps({"image": make_images(1)[0].tolist(),
+                           "deadline_ms": 10000.0}).encode()
+        req = urllib.request.Request(
+            f"{base}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        # the in-flight request dies with the only replica -> typed 500,
+        # answered promptly (never hangs out its deadline)
+        t0 = time.time()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 500
+        assert json.loads(ei.value.read())["status"] == "internal_error"
+        assert time.time() - t0 < 8.0
+        # retired pool: health says dead worker, /predict is a typed 503
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            snap = svc.stats()["replicas"][0]
+            if snap["state"] == "retired" and not snap["thread_alive"]:
+                break
+            time.sleep(0.1)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/healthz", timeout=30)
+        assert ei.value.code == 503
+        h = json.loads(ei.value.read())
+        assert h["status"] == "unhealthy" and h["worker_alive"] is False
+        assert h["replicas"] == {"total": 1, "healthy": 0, "retired": 1}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "overloaded"
+    events = [json.loads(line)
+              for line in open(f"{tmp_path}/serve/events.jsonl")]
+    retire = [e for e in events if e.get("name") == "serve.replica.retire"]
+    assert retire and retire[0]["max_queue_depth"] == 0
+
+
 # ---------- incremental certify on the serve hot path ----------
 
 def test_serve_incremental_zero_recompile_e2e(tmp_path):

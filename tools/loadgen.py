@@ -8,7 +8,10 @@ counts identical before and after traffic).
 
 Modes:
 - **closed loop** (default): `--concurrency` workers each keep exactly one
-  request in flight — classic latency-vs-throughput operating point.
+  request in flight — classic latency-vs-throughput operating point. A 503
+  `overloaded` reject is retried in place (same worker, same slot) after
+  the shared capped-exponential backoff (`dorpatch_tpu.backoff`), up to
+  `--max-retries`; the JSON line reports how many retries that took.
 - **open loop**: requests arrive at `--rate` per second regardless of
   completions — the overload probe; expect typed `overloaded` rejects once
   the arrival rate outruns the service, never unbounded queueing.
@@ -107,16 +110,34 @@ def run_load(send, images: np.ndarray, args) -> dict:
     """Fire the workload; returns per-request (status, latency_s) tuples
     aggregated into the report dict."""
     results = []
+    retry = {"total": 0, "requests_retried": 0, "exhausted": 0}
     res_lock = threading.Lock()
+    # closed loop only: an open-loop run MEASURES the overload response, so
+    # retrying there would rewrite the arrival process it exists to impose
+    retries = args.max_retries if args.mode == "closed" else 0
 
     def fire(i: int) -> None:
+        from dorpatch_tpu.backoff import retry_delay
+
         t0 = time.perf_counter()
-        resp = send(images[i % len(images)], args.deadline_ms)
+        attempt = 0
+        while True:
+            resp = send(images[i % len(images)], args.deadline_ms)
+            status = (resp.get("status", "error") if isinstance(resp, dict)
+                      else resp.status)
+            if status != "overloaded" or attempt >= retries:
+                break
+            attempt += 1
+            time.sleep(retry_delay(f"loadgen-{i}", attempt,
+                                   base=args.retry_base, cap=args.retry_cap))
         dt = time.perf_counter() - t0
         with res_lock:
-            results.append((resp.get("status", "error")
-                            if isinstance(resp, dict)
-                            else resp.status, dt))
+            results.append((status, dt))
+            if attempt:
+                retry["total"] += attempt
+                retry["requests_retried"] += 1
+                if status == "overloaded":
+                    retry["exhausted"] += 1
 
     t_start = time.perf_counter()
     if args.mode == "closed":
@@ -178,6 +199,7 @@ def run_load(send, images: np.ndarray, args) -> dict:
                        "count": len(ok)},
         "reject_rate": round(by_status.get("overloaded", 0) / total, 4)
         if total else 0.0,
+        "retries": dict(retry),
     }
 
 
@@ -191,6 +213,13 @@ def main(argv=None) -> int:
     p.add_argument("--rate", type=float, default=50.0,
                    help="open-loop arrival rate (req/sec)")
     p.add_argument("--deadline-ms", type=float, default=5000.0)
+    p.add_argument("--max-retries", type=int, default=4,
+                   help="closed loop: retry an `overloaded` reject this "
+                        "many times (0 disables); open loop never retries")
+    p.add_argument("--retry-base", type=float, default=0.05,
+                   help="first-retry backoff seconds (doubles per attempt)")
+    p.add_argument("--retry-cap", type=float, default=2.0,
+                   help="backoff ceiling seconds")
     p.add_argument("--url", default="",
                    help="target a running HTTP front-end instead of an "
                         "in-process service")

@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Serve-side chaos smoke: supervised-pool failover end-to-end (CI gate,
+`run_tests.sh`).
+
+Three phases, one process, one throwaway AOT store, one stub victim:
+
+A. CONTROL — a 1-replica unfaulted service (AOT mode "auto" against the
+   empty store, so this pass also populates one entry per serving
+   program) answers a seeded batch; its verdicts are the parity
+   reference.
+B. CHAOS — a 2-replica service boots strictly from the store (recompile
+   watchdog ARMED, zero traces on every replica's bank) and serves the
+   same batch under concurrent load while chaos wedges replica 0 mid-batch
+   with requests in flight. Every admitted request must be answered ok
+   exactly once (failover re-dispatch inside the original deadline —
+   nothing lost, nothing double-answered) with verdicts bit-identical to
+   phase A.
+C. RECOVERY — the supervisor must classify the wedge, quarantine, and
+   restart replica 0 through the AOT store: all hits, ZERO traces on the
+   restarted bank under the still-armed watchdog. A second pass over the
+   seeded batch must again match phase A, and the report CLI must render
+   the `-- replicas --` lifecycle accounting.
+
+Prints ONE JSON line: {"metric": "serve_chaos_smoke", "ok": true, ...};
+exits non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu.config import AotConfig, DefenseConfig, ServeConfig
+    from dorpatch_tpu.observe import report as report_mod
+    from dorpatch_tpu.serve.service import CertifiedInferenceService
+
+    num_classes, img = 5, 32
+
+    # fresh closure per service: jax.jit shares its trace cache across
+    # wrappers of the same function object, so one shared apply_fn would
+    # leak the control's traces into the chaos service's zero-trace books
+    def make_apply():
+        def apply_fn(params, x):
+            s = x.mean(axis=(1, 2, 3))
+            return jax.nn.one_hot((s * 7.0).astype(jnp.int32) % num_classes,
+                                  num_classes)
+        return apply_fn
+
+    defense_cfg = DefenseConfig(ratios=(0.1,), chunk_size=64)
+    rng = np.random.default_rng(0)
+    images = rng.uniform(0.0, 1.0, (12, img, img, 3)).astype(np.float32)
+
+    def drive(svc, deadline_ms=15000.0, concurrency=6):
+        """Concurrent closed-loop pass over the seeded batch; every request
+        must come back ok — a lost request surfaces here as a typed error
+        or a deadline, never a hang (the client wait loop is bounded)."""
+        out = [None] * len(images)
+        nxt = {"i": 0}
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    i = nxt["i"]
+                    if i >= len(images):
+                        return
+                    nxt["i"] = i + 1
+                out[i] = svc.predict(images[i], deadline_ms=deadline_ms)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
+    def verdicts(results):
+        return [(r.prediction, r.certified, r.clean_prediction)
+                for r in results]
+
+    failures = []
+    stats = {"metric": "serve_chaos_smoke"}
+    store_dir = tempfile.mkdtemp(prefix="serve-chaos-store-")
+    result_dir = tempfile.mkdtemp(prefix="serve-chaos-telemetry-")
+    try:
+        # ---- A: 1-replica unfaulted control (also populates the store) ----
+        control = CertifiedInferenceService(
+            make_apply(), None, num_classes, img,
+            serve_cfg=ServeConfig(max_batch=4, bucket_sizes=(1, 2, 4),
+                                  deadline_ms=15000.0, replicas=1),
+            defense_cfg=defense_cfg,
+            aot_cfg=AotConfig(cache_dir=store_dir, mode="auto"))
+        control.start()
+        n_programs = len(control.trace_entrypoints())
+        ref = drive(control)
+        bad = [r.status for r in ref if r.status != "ok"]
+        control.stop()
+        if bad:
+            failures.append(f"control pass not all ok: {bad}")
+            return _finish(stats, failures)  # no reference to compare against
+        want = verdicts(ref)
+        stats["programs"] = n_programs
+        stats["control_completed"] = control.stats()["completed"]
+
+        # ---- B: 2-replica strict warm boot + chaos under load ----
+        svc = CertifiedInferenceService(
+            make_apply(), None, num_classes, img,
+            serve_cfg=ServeConfig(max_batch=4, bucket_sizes=(1, 2, 4),
+                                  deadline_ms=15000.0, replicas=2,
+                                  max_restarts=2, restart_backoff_base=0.2,
+                                  restart_backoff_cap=1.0,
+                                  replica_stale_s=0.6,
+                                  chaos="wedge_dispatch"),
+            defense_cfg=defense_cfg, result_dir=result_dir,
+            aot_cfg=AotConfig(cache_dir=store_dir, mode="strict"))
+        svc.start()  # AotBootError here IS a failure: strict miss
+        boot_traces = [r["trace_counts"] for r in svc.stats()["replicas"]]
+        stats["boot_trace_counts"] = boot_traces
+        if any(t != 0 for t in boot_traces):
+            failures.append(f"warm boot traced: per-replica {boot_traces}, "
+                            f"expected all 0 (every program from the store)")
+
+        got = drive(svc)
+        statuses = [getattr(r, "status", "?") for r in got]
+        if statuses != ["ok"] * len(images):
+            failures.append(f"chaos pass lost/failed requests: {statuses}")
+        elif verdicts(got) != want:
+            failures.append("chaos-pass verdicts diverged from the "
+                            "1-replica unfaulted control")
+        st = svc.stats()
+        stats["failover"] = st["failover"]
+        stats["chaos_completed"] = st["completed"]
+        if st["failover"]["redispatched"] < 1:
+            failures.append("chaos never forced a failover re-dispatch — "
+                            "the wedge did not land mid-batch")
+        if st["completed"] != len(images):
+            failures.append(
+                f"completed={st['completed']} after {len(images)} requests "
+                f"— a request was double-answered or lost")
+
+        # ---- C: AOT-warm restart + post-recovery parity + report ----
+        deadline = time.time() + 120.0
+        snap = None
+        while time.time() < deadline:
+            snap = {r["replica"]: r for r in svc.stats()["replicas"]}
+            if snap[0]["state"] == "healthy" and snap[0]["generation"] == 1:
+                break
+            time.sleep(0.25)
+        stats["replica0"] = {k: snap[0][k] for k in
+                            ("state", "generation", "restarts",
+                             "trace_counts")} if snap else None
+        if not snap or snap[0]["state"] != "healthy" \
+                or snap[0]["generation"] != 1:
+            failures.append(f"replica 0 never restarted: {snap}")
+        elif snap[0]["trace_counts"] != 0:
+            failures.append(
+                f"restarted replica traced {snap[0]['trace_counts']} "
+                f"program(s) — the AOT warm restart compiled instead of "
+                f"loading under the armed watchdog")
+
+        post = drive(svc)
+        post_status = [getattr(r, "status", "?") for r in post]
+        if post_status != ["ok"] * len(images):
+            failures.append(f"post-recovery pass failed: {post_status}")
+        elif verdicts(post) != want:
+            failures.append("post-recovery verdicts diverged from control")
+        total_traces = [r["trace_counts"] for r in svc.stats()["replicas"]]
+        stats["final_trace_counts"] = total_traces
+        if any(t != 0 for t in total_traces):
+            failures.append(f"post-recovery traffic traced: {total_traces}")
+        events = [e for e in _read_jsonl(
+            os.path.join(result_dir, "events.jsonl"))]
+        restart_evs = [e for e in events
+                       if e.get("name") == "serve.replica.restart"]
+        if not restart_evs or restart_evs[0].get("aot_hits") != n_programs:
+            failures.append(
+                f"restart event reports aot_hits="
+                f"{restart_evs[0].get('aot_hits') if restart_evs else None},"
+                f" expected {n_programs} (all programs from the store)")
+        svc.stop()
+
+        rendered = report_mod.format_report(report_mod.summarize(result_dir))
+        if "-- replicas --" not in rendered:
+            failures.append("report does not render the -- replicas -- "
+                            "lifecycle section")
+        if "1 restart(s)" not in rendered:
+            failures.append("report replica section missing the restart "
+                            "accounting")
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+        shutil.rmtree(result_dir, ignore_errors=True)
+
+    return _finish(stats, failures)
+
+
+def _read_jsonl(path):
+    rows = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    try:
+                        rows.append(json.loads(line))
+                    except ValueError:
+                        continue
+    except OSError:
+        pass
+    return rows
+
+
+def _finish(stats, failures) -> int:
+    stats["ok"] = not failures
+    stats["failures"] = failures
+    print(json.dumps(stats))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
