@@ -325,17 +325,34 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
     image pays this floor). `forward_equivalents_total_per_image` is the
     whole certify's fractional cost, and MFU credits fractional forwards.
     Incremental engines run the f32 params path (bf16 requests fall back,
-    logged)."""
+    logged).
+
+    BENCH_MESH="DxM" (e.g. "4x2") runs the whole certify on a (data=D,
+    mask=M) device mesh: the exhaustive sweep shards as before, the pruned
+    path plans its phase-2 worklists shard-locally (defense._schedule_mesh)
+    — so BENCH_PRUNE=ab on a mesh A/Bs sharded-pruned vs sharded-exhaustive
+    with the same parity contract, and the BENCH row carries the mesh
+    shape."""
     import jax
     import jax.numpy as jnp
 
-    from dorpatch_tpu import data as data_lib
+    from dorpatch_tpu import data as data_lib, parallel
     from dorpatch_tpu.config import DefenseConfig
     from dorpatch_tpu.defense import build_defenses
     from dorpatch_tpu.models import get_model
 
     prune = os.environ.get("BENCH_PRUNE") or "exact"
     incr = os.environ.get("BENCH_INCR") or "off"
+    mesh = None
+    mesh_env = os.environ.get("BENCH_MESH") or ""
+    if mesh_env:
+        d_ax, m_ax = (int(v) for v in mesh_env.split("x"))
+        if jax.device_count() < d_ax * m_ax:
+            raise AssertionError(
+                f"BENCH_MESH={mesh_env} needs {d_ax * m_ax} devices, have "
+                f"{jax.device_count()} (on CPU: XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={d_ax * m_ax})")
+        mesh = parallel.make_mesh(d_ax, m_ax)
     victim = get_model(dataset, arch, img_size=img,
                        gn_impl=os.environ.get("BENCH_GN") or "auto")
     apply_fn = victim.apply
@@ -354,17 +371,24 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
                 jnp.float32)
 
     def make_defense(mode, incremental="off"):
-        return build_defenses(
-            apply_fn, img, DefenseConfig(ratios=(0.06,), chunk_size=128,
-                                         prune=mode,
-                                         incremental=incremental),
-            incremental=victim.incremental if incremental != "off"
-            else None)[0]
+        cfg = DefenseConfig(ratios=(0.06,), chunk_size=128, prune=mode,
+                            incremental=incremental)
+        engine = victim.incremental if incremental != "off" else None
+        if mesh is not None:
+            return parallel.make_sharded_defenses(
+                apply_fn, img, mesh, cfg, incremental=engine)[0]
+        return build_defenses(apply_fn, img, cfg, incremental=engine)[0]
 
     key = jax.random.PRNGKey(0)
     x = jax.random.uniform(key, (batch, img, img, 3))
     q = max(4, img // 8)
-    x = x.at[batch // 2:, :q, :q, :].set(1.0)  # the disagreement inducer
+    # the disagreement inducer, interleaved rather than a contiguous block:
+    # single-chip scheduling is order-blind, but the meshed pruned path
+    # plans worklists per contiguous data-axis shard — a sorted batch would
+    # pile every dense-route image onto one shard (all-padding waves
+    # elsewhere), which benchmarks ownership skew, not the schedule. Real
+    # eval batches arrive shuffled.
+    x = x.at[1::2, :q, :q, :].set(1.0)
     # power-of-two ladder (denser than the serving default): the measured
     # quantity is the certify schedule, not bucket-padding luck — with the
     # sparse (1, 8) ladder a 2-image pair worklist pads 4x while a 1-image
@@ -372,6 +396,10 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
     # Both A/B sides use the same ladder, so comparisons stay fair.
     buckets = tuple(sorted({2 ** i for i in range(max(1, batch.bit_length() - 1))}
                            | {1, batch}))
+    if mesh is not None:
+        # meshed certifiers keep the exact batch (begin_pruned never pads
+        # on a mesh; phase 2 buckets at its own [S * bucket] wave shapes)
+        buckets = None
 
     from dorpatch_tpu import observe
 
@@ -379,6 +407,10 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
 
     def time_mode(mode, xx, incremental="off"):
         d = make_defense(mode, incremental)
+        if mesh is not None:
+            # sharded over the data axis when it divides the batch; the
+            # eager refresh arithmetic below preserves the placement
+            xx = parallel.place_batch_auto(mesh, xx)
         t0 = time.perf_counter()
         d.robust_predict(victim.params, xx, victim.num_classes,
                          bucket_sizes=buckets)
@@ -404,6 +436,8 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
         return d, xx, sum(timer.block_seconds) / reps, recs
 
     prune_stats = {"prune": prune}
+    if mesh is not None:
+        prune_stats["mesh"] = f"{d_ax}x{m_ax}"
     if prune == "ab":
         d, x_final, dt_ex, recs_ex = time_mode("off", x)
         _, _, dt, recs = time_mode("exact", x)
@@ -829,6 +863,22 @@ def main() -> None:
                           "error": f"unknown BENCH_INCR={bi!r} (use 'off', "
                                    "'on' or 'ab')"}))
         return
+    bm = os.environ.get("BENCH_MESH") or ""
+    if bm:
+        parts = bm.split("x")
+        if (os.environ.get("BENCH_MODE") or "attack") != "certify":
+            print(json.dumps({"metric": err_metric, "value": 0.0,
+                              "unit": "images/sec", "vs_baseline": 0.0,
+                              "error": "BENCH_MESH only applies to "
+                                       "BENCH_MODE=certify"}))
+            return
+        if len(parts) != 2 or not all(p.isdigit() and int(p) > 0
+                                      for p in parts):
+            print(json.dumps({"metric": err_metric, "value": 0.0,
+                              "unit": "images/sec", "vs_baseline": 0.0,
+                              "error": f"malformed BENCH_MESH={bm!r} (use "
+                                       "'DATAxMASK', e.g. '4x2')"}))
+            return
     if bp == "ab" and bi != "off":
         # the prune A/B branch runs both sides with the incremental engine
         # off; accepting BENCH_INCR=on|ab here would silently report
@@ -942,7 +992,7 @@ def main() -> None:
               "prune_rate", "ips_exhaustive", "prune_speedup", "parity",
               "parity_mismatches", "incr", "incr_speedup", "ips_pruned_only",
               "forward_equivalents_per_image",
-              "forward_equivalents_total_per_image"):
+              "forward_equivalents_total_per_image", "mesh"):
         if res.get(k) is not None:
             out[k] = res[k]
     if fallback is not None:

@@ -358,6 +358,45 @@ def _enumerate_incremental() -> None:
                                     name=name)
 
 
+def _enumerate_sharded_defense(apply_fn, params) -> None:
+    """The meshed pruned-certification bank (`.mesh`-tagged program names —
+    a distinct program set: sharded fills, replicated out_shardings,
+    `[S * bucket]` phase-2 wave shapes; see defense._schedule_mesh). One
+    representative radius on a (2, n/2) mesh. `d._predict` is NOT
+    re-registered: its wrapper name is radius-keyed, not mesh-keyed, and
+    the single-chip bank already covers it. Enumerated only when the host
+    exposes an even multi-device count (the test gate forces an 8-device
+    virtual CPU mesh), like `_enumerate_sharded_ops`."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.device_count() < 2 or jax.device_count() % 2:
+        return
+    from dorpatch_tpu.config import DefenseConfig
+    from dorpatch_tpu.defense import build_defenses
+    from dorpatch_tpu.parallel import make_mesh, shard_apply_fn
+
+    mesh = make_mesh(2, jax.device_count() // 2)
+    d = build_defenses(shard_apply_fn(apply_fn, mesh), AUDIT_IMG_SIZE,
+                       DefenseConfig(ratios=(0.06,), chunk_size=64),
+                       mesh=mesh, recompile_budget=1)[0]
+    params_abs = abstractify(params)
+    imgs = jax.ShapeDtypeStruct(
+        (AUDIT_BATCH, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    register_entrypoint(d._phase1, (params_abs, imgs))
+    # phase 2 dispatches at [S * bucket] waves over the row ladder (pairs
+    # included — on a mesh their declared budget is the row ladder's length)
+    wave = int(mesh.shape["data"]) * int(d.row_bucket_sizes[0])
+    imgs_g = jax.ShapeDtypeStruct(
+        (wave, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    register_entrypoint(d._pairs, (params_abs, imgs_g))
+    register_entrypoint(d._rows,
+                        (params_abs, imgs_g,
+                         jax.ShapeDtypeStruct((wave,), jnp.int32)))
+    register_bucket_ladder(d._pairs._name, d.row_bucket_sizes)
+    register_bucket_ladder(d._rows._name, d.row_bucket_sizes)
+
+
 def _enumerate_train() -> None:
     from dorpatch_tpu import train
 
@@ -442,4 +481,5 @@ def production_entrypoints(clear: bool = True) -> List[EntryPoint]:
         _enumerate_model_init()
         _enumerate_serve(apply_fn, params)
         _enumerate_sharded_ops()
+        _enumerate_sharded_defense(apply_fn, params)
     return registered_entrypoints()

@@ -115,9 +115,13 @@ class DefenseConfig:
                                     #    asserts round-1 consensus only and
                                     #    can exceed the exhaustive audit —
                                     #    opt-in, see README "Certification".
-                                    # Meshed defenses always run "off"
-                                    # (gather/padding would re-lay-out
-                                    # sharded inputs).
+                                    # Runs on single-chip AND meshed
+                                    # defenses: meshes plan phase-2
+                                    # worklists shard-locally and dispatch
+                                    # them as fixed [S * bucket] SPMD
+                                    # waves (defense._schedule_mesh);
+                                    # n_patch != 1 families downgrade to
+                                    # "off" (one-time observe event).
     incremental: str = "auto"       # mask-aware incremental masked
                                     # forwards on the pruned certify path:
                                     #  "auto" (default) — per family:
@@ -126,8 +130,10 @@ class DefenseConfig:
                                     #    "stem" for conv victims (exact by
                                     #    construction), "off" where no
                                     #    engine exists (ResMLP, stub
-                                    #    apply_fns, meshed or n_patch!=1
-                                    #    certifiers, prune="off").
+                                    #    apply_fns, n_patch!=1 certifiers,
+                                    #    prune="off"). Meshed certifiers
+                                    #    run it too, on the same
+                                    #    shard-local schedule.
                                     #  "token" — token-pruned ViT forwards
                                     #    (clean KV cache + dirty-token
                                     #    recompute; per-mask cost scales

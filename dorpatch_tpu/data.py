@@ -99,6 +99,29 @@ def bucket_plan(n: int, buckets: Sequence[int]):
     return plan
 
 
+def shard_bucket_plan(counts: Sequence[int], buckets: Sequence[int]):
+    """Per-shard greedy bucket cover for S parallel worklists dispatched as
+    ONE `[S * bucket]` program call per wave:
+    `[(offset, per_shard_counts, bucket), ...]`.
+
+    The meshed two-phase certify scheduler plans each shard's phase-2
+    worklist shard-locally but must dispatch a single SPMD program per
+    wave (every shard participates in every call). The wave ladder is
+    therefore `bucket_plan` applied to the LONGEST worklist — the wave
+    loop runs until the busiest shard drains — and every other shard
+    contributes `min(bucket, its remaining entries)` real rows to the same
+    wave, its leftover slots being padding the caller fills with a
+    replicated owned row. Wave shapes depend only on the ladder and the
+    max count, never on the per-shard skew: a worklist where one shard
+    holds every entry and the rest hold none compiles exactly the same
+    programs as the balanced case."""
+    counts = [int(c) for c in counts]
+    if not counts:
+        return []
+    return [(off, tuple(max(0, min(cnt, c - off)) for c in counts), bucket)
+            for off, cnt, bucket in bucket_plan(max(counts), buckets)]
+
+
 def pad_to_bucket(arr, bucket: int):
     """Pad axis 0 up to `bucket` rows by repeating the first row. Every
     consumer's verdict is a pure per-row function of its tables, so padded

@@ -71,6 +71,24 @@ per-image clean-activation cache:
   into per-mask delta convs over static windows scattered into one shared
   post-stem cache — algebraically exact, no tolerance; phase 2 keeps the
   standard programs (pair windows approach the full image).
+
+Meshed certifiers (a `(data, mask)` mesh attached) run the SAME two-phase
+schedule sharded: the fixed-shape 36-mask phase 1 shards over the mesh as
+the exhaustive sweep always did (no ragged shapes there), the one designed
+sync reads back the replicated `[B, 36]` label table, and phase-2
+worklists are planned SHARD-LOCALLY — images split contiguously over the
+data axis (matching `place_batch`'s block layout), each shard's worklist
+is bucket-planned independently (`data.shard_bucket_plan`), and every
+wave dispatches one `[S * bucket]` SPMD program call whose rows
+interleave the shards' entries, gathered host-side and placed sharded
+over the data axis. Shards whose worklist ran dry pad their slots with a
+replicated owned row (discarded). Wave shapes depend only on the static
+row-bucket ladder, never on the batch size or verdict mix — zero
+recompiles — and since padding is excluded from every table read and
+forward count, verdicts and per-image `forwards` stay bit-identical to
+the single-chip pruned oracle. The incremental engines ride the same
+shard-local schedule unchanged (their programs are pure jnp; GSPMD
+propagates the data sharding through them).
 """
 
 from __future__ import annotations
@@ -384,8 +402,15 @@ class _PrunedPending:
         self.unanimous = None
         self.pair_idx = np.zeros((0,), np.int64)
         self.row_list = []
-        self.pair_chunks = []      # [(device preds/(preds,margins), off, count)]
-        self.row_chunks = []       # [(device preds/(preds,margins), w_real, entries)]
+        # phase-2 chunk bookkeeping: (device preds/(preds,margins), mapping)
+        # where mapping names the REAL entries — [(table_row, image)] for
+        # pair chunks, [(table_row, image, first_mask)] for row chunks.
+        # Explicit row->entry maps keep finalize() identical across the
+        # single-chip layout (real rows first, padding last) and the mesh
+        # wave layout (shard s owns rows [s*bucket, (s+1)*bucket), padding
+        # interleaved per shard).
+        self.pair_chunks = []
+        self.row_chunks = []
 
     def schedule(self) -> "_PrunedPending":
         """THE one designed host sync of the pruned path: materialize the
@@ -402,6 +427,12 @@ class _PrunedPending:
             pc.num_first, pc.num_second, self.mode)
         self.pair_idx = np.nonzero(need_pairs)[0]
 
+        token = self.incr in ("token", "token-exact")
+        pairs_prog = pc._pairs_incr if token else pc._pairs
+        grid_full = np.asarray(pc._grid_full)
+        if pc.mesh is not None:
+            return self._schedule_mesh(pairs_prog, grid_full, token)
+
         # Both worklists dispatch through a greedy bucket decomposition
         # (`data.bucket_plan`: full buckets largest-first, one padded tail)
         # rather than a single rounded-up call — a 34-entry worklist over
@@ -412,8 +443,6 @@ class _PrunedPending:
         # get one derived from their fixed batch size: the pair worklist
         # size varies with the batch's verdict mix, and dispatching at the
         # raw size would recompile the 630-mask program per distinct k.
-        token = self.incr in ("token", "token-exact")
-        pairs_prog = pc._pairs_incr if token else pc._pairs
         if self.pair_idx.size:
             k = int(self.pair_idx.size)
             bs = (self.bucket_sizes if self.bucket_sizes is not None
@@ -423,10 +452,11 @@ class _PrunedPending:
                     jnp.take(self.imgs,
                              jnp.asarray(self.pair_idx[off:off + cnt]),
                              axis=0), bucket)
+                mapping = [(pos, int(self.pair_idx[off + pos]))
+                           for pos in range(cnt)]
                 self.pair_chunks.append((pairs_prog(self.params, xu),
-                                         off, cnt))
+                                         mapping))
 
-        grid_full = np.asarray(pc._grid_full)
         for off, w, wb in data_lib.bucket_plan(len(self.row_list),
                                                pc.row_bucket_sizes):
             chunk = self.row_list[off:off + w]
@@ -443,7 +473,89 @@ class _PrunedPending:
             else:
                 t = pc._rows(self.params, xg,
                              jnp.asarray(mask_idx, dtype=jnp.int32))
-            self.row_chunks.append((t, w, chunk))
+            self.row_chunks.append(
+                (t, [(pos, b, i) for pos, (b, i) in enumerate(chunk)]))
+        return self
+
+    def _schedule_mesh(self, pairs_prog, grid_full, token: bool):
+        """Shard-local phase-2 dispatch (the meshed leg of the two-phase
+        schedule; see the module docstring's mesh paragraph).
+
+        Images are owned contiguously along the data axis (matching the
+        contiguous block layout `place_batch`'s data sharding produces, so
+        a shard mostly forwards rows it already holds); each shard's
+        worklist is bucket-planned independently over the STATIC row
+        ladder (`data.shard_bucket_plan`), and every wave is ONE
+        `[S * bucket]` SPMD call whose rows interleave the shards' entries
+        — shard s owns rows [s*bucket, (s+1)*bucket) — gathered host-side
+        (the single-chip path's eager-gather idiom) and placed sharded
+        over the data axis. A shard whose worklist ran dry fills its slots
+        with an owned row (its first scheduled entry, else its first owned
+        image, else image 0): the replicated-rows fallback — valid
+        forwards whose outputs no mapping entry reads. Wave shapes never
+        depend on the batch size or the verdict mix, so the bank stays
+        zero-recompile past the ladder; padding is excluded from every
+        table read and forward count, so verdicts stay bit-identical to
+        the single-chip pruned oracle."""
+        pc = self.pc
+        n, S = self.n, pc._mesh_data
+        blocks = np.array_split(np.arange(n), S)
+        lo = [int(b[0]) if b.size else n for b in blocks]
+        hi = [int(b[-1]) + 1 if b.size else n for b in blocks]
+
+        if self.pair_idx.size:
+            per = [self.pair_idx[(self.pair_idx >= lo[s])
+                                 & (self.pair_idx < hi[s])]
+                   for s in range(S)]
+            for off, counts, bucket in data_lib.shard_bucket_plan(
+                    [p.size for p in per], pc.row_bucket_sizes):
+                idx = np.zeros((S, bucket), np.int64)
+                mapping = []
+                for s in range(S):
+                    sel = per[s][off:off + counts[s]]
+                    fill = (int(sel[0]) if sel.size
+                            else int(per[s][0]) if per[s].size
+                            else lo[s] if lo[s] < n else 0)
+                    idx[s, :] = fill
+                    idx[s, :sel.size] = sel
+                    mapping += [(s * bucket + j, int(b))
+                                for j, b in enumerate(sel)]
+                xu = pc._mesh_place(
+                    jnp.take(self.imgs, jnp.asarray(idx.reshape(-1)),
+                             axis=0))
+                self.pair_chunks.append((pairs_prog(self.params, xu),
+                                         mapping))
+
+        per_rows = [[e for e in self.row_list if lo[s] <= e[0] < hi[s]]
+                    for s in range(S)]
+        for off, counts, w in data_lib.shard_bucket_plan(
+                [len(rw) for rw in per_rows], pc.row_bucket_sizes):
+            img_idx = np.zeros((S, w), np.int64)
+            mask_idx = np.zeros((S, w), np.int64)
+            mapping = []
+            for s in range(S):
+                sel = per_rows[s][off:off + counts[s]]
+                fb, fi = (sel[0] if sel
+                          else per_rows[s][0] if per_rows[s]
+                          else ((lo[s] if lo[s] < n else 0), 0))
+                img_idx[s, :] = fb
+                mask_idx[s, :] = fi
+                for j, (b, i) in enumerate(sel):
+                    img_idx[s, j] = b
+                    mask_idx[s, j] = i
+                    mapping.append((s * w + j, b, i))
+            xg = pc._mesh_place(
+                jnp.take(self.imgs, jnp.asarray(img_idx.reshape(-1)),
+                         axis=0))
+            flat_masks = mask_idx.reshape(-1)
+            if token:
+                t = pc._rows_incr(self.params, xg,
+                                  jnp.asarray(grid_full[flat_masks],
+                                              dtype=jnp.int32))
+            else:
+                t = pc._rows(self.params, xg,
+                             jnp.asarray(flat_masks, dtype=jnp.int32))
+            self.row_chunks.append((t, mapping))
         return self
 
     def finalize(self) -> List[PatchCleanserRecord]:
@@ -462,26 +574,27 @@ class _PrunedPending:
         if token and self.m1 is None:
             self.m1 = np.asarray(self.t1_margins)[:self.n]
 
-        def split(t, k):
-            """Materialize one phase-2 chunk: (preds [k, ...], margins)."""
+        def split(t):
+            """Materialize one phase-2 chunk: (preds, margins). Whole
+            tables come back (padding rows included); the chunk's mapping
+            names the only rows anything below reads."""
             if isinstance(t, tuple):
-                return np.asarray(t[0])[:k], np.asarray(t[1])[:k]
-            return np.asarray(t)[:k], None
+                return np.asarray(t[0]), np.asarray(t[1])
+            return np.asarray(t), None
 
         pair_tables = {}
         pair_margins = {}
-        for t, off, cnt in self.pair_chunks:
-            tbl, mg = split(t, cnt)
-            for pos in range(cnt):
-                b = int(self.pair_idx[off + pos])
+        for t, mapping in self.pair_chunks:
+            tbl, mg = split(t)
+            for pos, b in mapping:
                 pair_tables[b] = tbl[pos]
                 if mg is not None:
                     pair_margins[b] = mg[pos]
         rows = {}                      # image -> {mask i -> [M] row}
         row_margins = {}
-        for t, w, chunk in self.row_chunks:
-            tbl, mg = split(t, w)
-            for pos, (b, i) in enumerate(chunk):
+        for t, mapping in self.row_chunks:
+            tbl, mg = split(t)
+            for pos, b, i in mapping:
                 rows.setdefault(b, {})[i] = tbl[pos]
                 if mg is not None:
                     row_margins.setdefault(b, {})[i] = mg[pos]
@@ -573,12 +686,20 @@ class _PrunedPending:
         if not esc.size:
             return records
         m, p = pc.num_first, pc.num_second
-        bs = (self.bucket_sizes if self.bucket_sizes is not None
-              else data_lib.batch_buckets(int(self.imgs.shape[0])))
+        if pc.mesh is not None:
+            # meshed certifiers bucket escalations on the row ladder (the
+            # mesh phase-2 ladder) so the exhaustive program's warm shapes
+            # stay the fixed `row_bucket_sizes` set — see `warm_pruned`.
+            bs = pc.row_bucket_sizes
+        else:
+            bs = (self.bucket_sizes if self.bucket_sizes is not None
+                  else data_lib.batch_buckets(int(self.imgs.shape[0])))
         for off, cnt, bucket in data_lib.bucket_plan(int(esc.size), bs):
             xe = data_lib.pad_to_bucket(
                 jnp.take(self.imgs, jnp.asarray(esc[off:off + cnt]), axis=0),
                 bucket)
+            if pc.mesh is not None:
+                xe = pc._mesh_place(xe)
             pred, cert, p1, p2 = map(
                 np.asarray,
                 pc._predict(self.params, xe, int(self.num_classes)))
@@ -640,6 +761,11 @@ class PatchCleanser:
     #: the batch, and nothing device-resident is pinned past the call)
     last_min_margin: Any = dataclasses.field(default=None, init=False,
                                              repr=False)
+    #: one-shot latch for the `defense.prune_downgrade` observe event: a
+    #: certifier that silently runs exhaustive must say why exactly once,
+    #: so report/serve stats can explain a 666 forwards/image row
+    _downgrade_logged: bool = dataclasses.field(default=False, init=False,
+                                                repr=False)
 
     def __post_init__(self):
         singles, doubles = masks_lib.mask_sets(self.spec)
@@ -664,27 +790,52 @@ class PatchCleanser:
                 p1, p2, self._num_singles, num_classes)
             return pred, certified, p1, p2
 
-        out_shardings = None
+        self._out_shardings = None
+        self._mesh_data = 0
         if self.mesh is not None:
             # replicated outputs: the [B]/[B,M] verdict tables must be
             # host-addressable on EVERY process of a multi-process run
             # (robust_predict materializes them with np.asarray)
             from jax.sharding import NamedSharding, PartitionSpec
 
-            out_shardings = NamedSharding(self.mesh, PartitionSpec())
+            self._out_shardings = NamedSharding(self.mesh, PartitionSpec())
+            # data-axis size S of the attached mesh: the shard-local
+            # phase-2 scheduler's wave width multiplier (meshes without a
+            # "data" axis degenerate to single-list planning, S=1)
+            self._mesh_data = int(dict(self.mesh.shape).get("data", 1)) or 1
         # telemetry: first call = trace + XLA compile of the whole 666-mask
         # sweep; recorded as a `compile` event on the driver's EventLog
         self._predict = observe.timed_first_call(
-            jax.jit(_predict, static_argnums=2, out_shardings=out_shardings),
+            jax.jit(_predict, static_argnums=2,
+                    out_shardings=self._out_shardings),
             f"defense.predict.r{self.spec.patch_ratio}",
             recompile_budget=self.recompile_budget)
-        if self.mesh is None and self.spec.n_patch == 1:
+        if self.spec.n_patch == 1:
             self._build_pruned_programs()
 
+    def _mesh_place(self, x):
+        """Place a host-gathered batch on the mesh: sharded over the data
+        axis when it divides the leading dim (the `[S * bucket]` phase-2
+        wave batches always do), replicated otherwise (ragged escalation
+        tails — tiny next to the masked activation batch). jit cache keys
+        include input shardings, so `warm_pruned` routes its warm batches
+        through this same rule to guarantee warm placements match live
+        dispatch."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec()
+        if self._mesh_data > 1 and x.shape[0] % self._mesh_data == 0:
+            spec = PartitionSpec("data", *(None,) * (x.ndim - 1))
+        return jax.device_put(x, NamedSharding(self.mesh, spec))
+
     def _build_pruned_programs(self):
-        """The two-phase pruned path's three jitted programs (single-chip,
-        n_patch=1 families only; meshed certifiers stay exhaustive — the
-        host gather/padding would re-lay-out sharded inputs)."""
+        """The two-phase pruned path's three jitted programs (n_patch=1
+        families; single-chip AND meshed certifiers — on a mesh the
+        programs jit with replicated out_shardings so the tiny label
+        tables stay host-addressable, carry `.mesh`-tagged telemetry names
+        (a distinct program bank: sharded fills, different trace shapes),
+        and phase 2 dispatches them at `[S * bucket]` shard-local wave
+        shapes over the static row-bucket ladder — see `_schedule_mesh`)."""
         m = self._num_singles
         rects_first = self._rects[:m]
         # combined-table index grid: row i = the second-round mask set of
@@ -697,47 +848,90 @@ class PatchCleanser:
         # chunked sweep's B*chunk live-memory contract carries over
         self.row_bucket_sizes = data_lib.batch_buckets(
             max(1, int(self.config.chunk_size)))
+        if self.mesh is not None:
+            # meshes plan PER-SHARD worklists (~S times smaller than the
+            # global one) and dispatch the whole phase 2 — pair audit
+            # included — at these rungs, so the sparse x4 ladder's tail
+            # padding (up to 7/8 of a wave, on every shard at once) would
+            # routinely exceed the pruning savings. A dense power-of-two
+            # ladder bounds the waste at 2x; it is still a fixed set, so
+            # the per-rung compile contract (and the declared trace
+            # budgets below) are unchanged in kind, just longer.
+            cap = max(1, int(self.config.chunk_size))
+            rungs = {1, cap}
+            b = 2
+            while b < cap:
+                rungs.add(b)
+                b *= 2
+            self.row_bucket_sizes = tuple(sorted(rungs))
 
         def _phase1(params, imgs):
             return masked_predictions(
                 self.apply_fn, params, imgs, rects_first,
                 self.config.chunk_size, self.config.mask_fill,
-                self.config.use_pallas)
+                self.config.use_pallas, mesh=self.mesh)
 
         def _pairs(params, imgs):
             return masked_predictions(
                 self.apply_fn, params, imgs, self._rects[m:],
                 self.config.chunk_size, self.config.mask_fill,
-                self.config.use_pallas)
+                self.config.use_pallas, mesh=self.mesh)
+
+        chunk_cap = max(1, int(self.config.chunk_size))
 
         def _rows(params, imgs_g, mask_idx):
             # [W,H,W,C] gathered images x [W] first-round mask ids ->
-            # [W, M] second-round rows: scan over the M second masks, each
-            # step rasterizing a PER-ENTRY rectangle set (entry w's step-j
-            # mask is {mask_idx[w], j}) and forwarding the [W] batch. The
-            # lerp fill is bitwise `ops.masked_fill`'s XLA reference path.
+            # [W, M] second-round rows: scan over the M second masks in
+            # groups of G columns, each step rasterizing a PER-ENTRY
+            # rectangle set (entry w's column-j mask is {mask_idx[w], j})
+            # and forwarding one [G*W] flat batch. G is the largest
+            # divisor of M that keeps G*W inside the chunked sweep's
+            # per-dispatch live-memory contract (G*W <= chunk_size) — a
+            # small row wave would otherwise run M skinny forwards, whose
+            # per-dispatch overhead (worst on a mesh, where each one is a
+            # whole-mesh collective step) dwarfs the compute. The lerp
+            # fill is bitwise `ops.masked_fill`'s XLA reference path.
             idx_tab = self._grid_full[mask_idx]           # [W, M]
             size = self.spec.img_size
+            w_sz = int(imgs_g.shape[0])
+            cap = max(1, chunk_cap // max(1, w_sz))
+            g = max(d for d in range(1, m + 1)
+                    if m % d == 0 and d <= cap) if cap > 1 else 1
 
-            def body(carry, idx_col):                     # idx_col [W]
-                rects = self._rects[idx_col]              # [W, K, 4]
+            def body(carry, idx_cols):                    # idx_cols [G, W]
+                rects = self._rects[idx_cols.reshape(-1)]  # [G*W, K, 4]
                 mk = masks_lib.rasterize(rects, size)[..., None]
                 mk = mk.astype(imgs_g.dtype)
-                xm = imgs_g * mk + self.config.mask_fill * (1.0 - mk)
-                return carry, jnp.argmax(self.apply_fn(params, xm), axis=-1)
+                xt = jnp.tile(imgs_g, (g, 1, 1, 1))
+                xm = xt * mk + self.config.mask_fill * (1.0 - mk)
+                preds = jnp.argmax(self.apply_fn(params, xm), axis=-1)
+                return carry, preds.reshape(g, w_sz)
 
-            _, out = jax.lax.scan(body, None, jnp.moveaxis(idx_tab, 0, 1))
-            return jnp.moveaxis(out, 0, 1)                # [W, M]
+            cols = jnp.moveaxis(idx_tab, 0, 1).reshape(m // g, g, w_sz)
+            _, out = jax.lax.scan(body, None, cols)
+            return jnp.moveaxis(out.reshape(m, w_sz), 0, 1)   # [W, M]
 
         r = self.spec.patch_ratio
         rb = self.recompile_budget
         row_rb = (len(self.row_bucket_sizes) if rb is not None else None)
+        # the meshed bank is a distinct program set (sharded fills,
+        # [S*bucket] wave shapes): tag its telemetry/audit names so the
+        # single-chip entries stay distinct in the baseline registry. On a
+        # mesh the pair audit dispatches at wave shapes over the row
+        # ladder (not the caller's image buckets), so its trace budget is
+        # the row ladder's too.
+        tag = self._prog_tag = ".mesh" if self.mesh is not None else ""
+        osh = self._out_shardings
+        pair_rb = row_rb if self.mesh is not None else rb
         self._phase1 = observe.timed_first_call(
-            jax.jit(_phase1), f"defense.phase1.r{r}", recompile_budget=rb)
+            jax.jit(_phase1, out_shardings=osh),
+            f"defense.phase1{tag}.r{r}", recompile_budget=rb)
         self._pairs = observe.timed_first_call(
-            jax.jit(_pairs), f"defense.pairs.r{r}", recompile_budget=rb)
+            jax.jit(_pairs, out_shardings=osh),
+            f"defense.pairs{tag}.r{r}", recompile_budget=pair_rb)
         self._rows = observe.timed_first_call(
-            jax.jit(_rows), f"defense.rows.r{r}", recompile_budget=row_rb)
+            jax.jit(_rows, out_shardings=osh),
+            f"defense.rows{tag}.r{r}", recompile_budget=row_rb)
 
         # forward-equivalent weights per combined-table mask (full-forward
         # units): all-ones without an engine; the token engine's family
@@ -753,15 +947,17 @@ class PatchCleanser:
             self._incr_family = fam
             kind = self.incremental_engine.kind
             self._phase1_incr = observe.timed_first_call(
-                jax.jit(fam.phase1), f"defense.phase1.{kind}.r{r}",
-                recompile_budget=rb)
+                jax.jit(fam.phase1, out_shardings=osh),
+                f"defense.phase1.{kind}{tag}.r{r}", recompile_budget=rb)
             if kind == "token":
                 self._fe_combined = np.asarray(fam.fe, np.float64)
                 self._pairs_incr = observe.timed_first_call(
-                    jax.jit(fam.pairs), f"defense.pairs.token.r{r}",
-                    recompile_budget=rb)
+                    jax.jit(fam.pairs, out_shardings=osh),
+                    f"defense.pairs.token{tag}.r{r}",
+                    recompile_budget=pair_rb)
                 self._rows_incr = observe.timed_first_call(
-                    jax.jit(fam.rows), f"defense.rows.token.r{r}",
+                    jax.jit(fam.rows, out_shardings=osh),
+                    f"defense.rows.token{tag}.r{r}",
                     recompile_budget=row_rb)
         # per-first-mask second-round row cost (all M entries of the row,
         # idempotence diagonal included — matching the row programs, which
@@ -803,13 +999,27 @@ class PatchCleanser:
         return float(self.num_first)
 
     def resolved_prune(self, prune: Optional[str] = None) -> str:
-        """The effective prune mode: explicit arg > config; meshed or
-        n_patch!=1 certifiers always run "off" (see _build_pruned_programs)."""
+        """The effective prune mode: explicit arg > config. The two-phase
+        pruned schedule runs on single-chip AND meshed certifiers — on a
+        mesh, phase 1 shards over the devices as the exhaustive sweep
+        always did and phase-2 worklists are planned shard-locally at
+        fixed `[S * bucket]` wave shapes (see `_schedule_mesh`), so there
+        is no mesh downgrade anymore. The one remaining downgrade is
+        n_patch != 1 mask families (their verdict reads the full combined
+        table; `_build_pruned_programs` never ran): they resolve to "off"
+        and emit a one-time `defense.prune_downgrade` observe event so
+        report/serve stats can explain why forwards/image is exhaustive."""
         mode = self.config.prune if prune is None else prune
         if mode not in PRUNE_MODES:
             raise ValueError(
                 f"prune={mode!r} (legal: {', '.join(PRUNE_MODES)})")
-        if self.mesh is not None or self.spec.n_patch != 1:
+        if self.spec.n_patch != 1:
+            if mode != "off" and not self._downgrade_logged:
+                self._downgrade_logged = True
+                observe.record_event(
+                    "defense.prune_downgrade", reason="n_patch",
+                    n_patch=int(self.spec.n_patch), requested=str(mode),
+                    ratio=float(self.spec.patch_ratio))
             return "off"
         return mode
 
@@ -819,16 +1029,17 @@ class PatchCleanser:
         resolves to the attached engine's kind. Always "off" without an
         engine (stub victims, ResMLP), without built incremental programs
         (config.incremental="off" at construction), or when the pruned
-        dispatch path itself is off (mesh, n_patch!=1, prune="off") —
-        incremental forwards ride the two-phase schedule. An explicit
-        token/stem request that contradicts the engine family is a
-        config error, not a silent fallback."""
+        dispatch path itself is off (n_patch!=1, prune="off") —
+        incremental forwards ride the two-phase schedule, including its
+        meshed shard-local form. An explicit token/stem request that
+        contradicts the engine family is a config error, not a silent
+        fallback."""
         mode = (self.config.incremental if incremental is None
                 else incremental)
         if mode not in INCREMENTAL_MODES:
             raise ValueError(f"incremental={mode!r} "
                              f"(legal: {', '.join(INCREMENTAL_MODES)})")
-        # meshed / n_patch!=1 certifiers never ran _build_pruned_programs
+        # n_patch!=1 certifiers never ran _build_pruned_programs
         if getattr(self, "_incr_family", None) is None \
                 or self.resolved_prune(prune) == "off":
             return "off"
@@ -855,23 +1066,28 @@ class PatchCleanser:
         "rows_sets" (params, gathered [W,H,W,C], [W,M] combined-table
         index rows — the token rows program)."""
         r = self.spec.patch_ratio
+        tag = getattr(self, "_prog_tag", "")
         mode = self.resolved_incremental(incremental)
         if mode in ("token", "token-exact"):
             return [
-                (f"defense.phase1.token.r{r}", self._phase1_incr, "imgs"),
-                (f"defense.pairs.token.r{r}", self._pairs_incr, "imgs"),
-                (f"defense.rows.token.r{r}", self._rows_incr, "rows_sets"),
+                (f"defense.phase1.token{tag}.r{r}", self._phase1_incr,
+                 "imgs"),
+                (f"defense.pairs.token{tag}.r{r}", self._pairs_incr,
+                 "imgs"),
+                (f"defense.rows.token{tag}.r{r}", self._rows_incr,
+                 "rows_sets"),
             ]
         if mode == "stem":
             return [
-                (f"defense.phase1.stem.r{r}", self._phase1_incr, "imgs"),
-                (f"defense.pairs.r{r}", self._pairs, "imgs"),
-                (f"defense.rows.r{r}", self._rows, "rows"),
+                (f"defense.phase1.stem{tag}.r{r}", self._phase1_incr,
+                 "imgs"),
+                (f"defense.pairs{tag}.r{r}", self._pairs, "imgs"),
+                (f"defense.rows{tag}.r{r}", self._rows, "rows"),
             ]
         return [
-            (f"defense.phase1.r{r}", self._phase1, "imgs"),
-            (f"defense.pairs.r{r}", self._pairs, "imgs"),
-            (f"defense.rows.r{r}", self._rows, "rows"),
+            (f"defense.phase1{tag}.r{r}", self._phase1, "imgs"),
+            (f"defense.pairs{tag}.r{r}", self._pairs, "imgs"),
+            (f"defense.rows{tag}.r{r}", self._rows, "rows"),
         ]
 
     def begin_pruned(
@@ -892,7 +1108,12 @@ class PatchCleanser:
         incr = self.resolved_incremental(incremental, prune)
         total = int(imgs.shape[0])
         n = total if n is None else int(n)
-        if bucket_sizes is not None and n and total == n:
+        # meshed certifiers keep the exact batch: bucket-padding would
+        # re-lay-out the caller's sharded input, and phase 2 pads at its
+        # own [S*bucket] wave shapes anyway (the image buckets only bound
+        # phase-1 trace shapes, covered by the caller's batch-size budget)
+        if self.mesh is None and bucket_sizes is not None and n \
+                and total == n:
             imgs = data_lib.pad_to_bucket(
                 imgs, data_lib.bucket_batch(n, bucket_sizes))
         return _PrunedPending(self, params, imgs, n, num_classes,
@@ -901,41 +1122,61 @@ class PatchCleanser:
     def warm_pruned(self, params, bucket_sizes: Sequence[int],
                     num_classes: Optional[int] = None) -> None:
         """Compile every program the resolved pruned(+incremental) path can
-        dispatch at run time: phase 1 and the pair audit per image bucket,
-        the row program per row bucket — and, under "token-exact", the
-        exhaustive escalation program per image bucket (pass `num_classes`;
-        it is a static argument of `_predict`). The serving warmup calls
-        this so live traffic provably never retraces regardless of which
-        verdict classes (and worklist sizes) it produces."""
+        dispatch at run time: phase 1 per image bucket, the pair audit and
+        row program per worklist bucket — and, under "token-exact", the
+        exhaustive escalation program (pass `num_classes`; it is a static
+        argument of `_predict`). The serving warmup calls this so live
+        traffic provably never retraces regardless of which verdict classes
+        (and worklist sizes) it produces.
+
+        Single-chip, the pair audit and escalation ride the image buckets
+        (`bucket_sizes`); phase-2 rows ride `row_bucket_sizes`. On a mesh
+        the whole phase 2 rides the row ladder — pairs and rows dispatch as
+        `[S * bucket]` waves (S = data-axis size), escalation at the row
+        buckets themselves — and every input is placed by the `_mesh_place`
+        rule so warm jit-cache keys (which include input shardings) match
+        live traffic."""
         size = self.spec.img_size
         mode = self.resolved_incremental()
         (_, phase1, _), (_, pairs, _), (_, rows, rows_kind) = \
             self.pruned_programs()
+        meshed = self.mesh is not None
+        place = self._mesh_place if meshed else (lambda x: x)
+        S = self._mesh_data if meshed else 1
+        if mode == "token-exact" and num_classes is None:
+            raise ValueError(
+                "warm_pruned needs num_classes under token-exact "
+                "(the escalation program's static argument)")
 
         def run(prog, *args):
             out = prog(*args)
             np.asarray(out[0] if isinstance(out, tuple) else out)
 
+        def full(b):
+            return place(jnp.full((int(b), size, size, 3), 0.5, jnp.float32))
+
         for b in bucket_sizes:
-            imgs = jnp.full((int(b), size, size, 3), 0.5, jnp.float32)
+            imgs = full(b)
             run(phase1, params, imgs)
-            run(pairs, params, imgs)
-            if mode == "token-exact":
-                if num_classes is None:
-                    raise ValueError(
-                        "warm_pruned needs num_classes under token-exact "
-                        "(the escalation program's static argument)")
-                run(self._predict, params, imgs, int(num_classes))
+            if not meshed:
+                run(pairs, params, imgs)
+                if mode == "token-exact":
+                    run(self._predict, params, imgs, int(num_classes))
         m = self.num_first
         for w in self.row_bucket_sizes:
-            imgs_g = jnp.full((int(w), size, size, 3), 0.5, jnp.float32)
+            wave = S * int(w)
+            imgs_g = full(wave)
             if rows_kind == "rows_sets":
                 sets = jnp.asarray(
                     np.broadcast_to(np.asarray(self._grid_full)[0],
-                                    (int(w), m)).copy())
+                                    (wave, m)).copy())
                 run(rows, params, imgs_g, sets)
             else:
-                run(rows, params, imgs_g, jnp.zeros((int(w),), jnp.int32))
+                run(rows, params, imgs_g, jnp.zeros((wave,), jnp.int32))
+            if meshed:
+                run(pairs, params, imgs_g)
+                if mode == "token-exact":
+                    run(self._predict, params, full(w), int(num_classes))
 
     def pruned_trace_counts(self) -> dict:
         """Compiled-trace count per active pruned-path program (the serving

@@ -9,6 +9,7 @@ from dorpatch_tpu.parallel.mesh import (
     flat_batch_sharding,
     make_mesh,
     place_batch,
+    place_batch_auto,
     place_batch_multihost,
     place_replicated,
     replicated,
@@ -30,6 +31,7 @@ __all__ = [
     "make_sharded_attack",
     "make_sharded_defenses",
     "place_batch",
+    "place_batch_auto",
     "place_batch_multihost",
     "place_replicated",
     "replicated",

@@ -167,6 +167,19 @@ def place_batch(mesh: Mesh, x: jax.Array, *per_image):
     return out[0] if not per_image else tuple(out)
 
 
+def place_batch_auto(mesh: Mesh, x: jax.Array) -> jax.Array:
+    """`place_batch` when the data axis divides the batch, replicated
+    otherwise — the certify/attack input-placement rule: a correctness
+    filter (or a ragged final batch) makes the surviving batch size
+    dynamic, and per-image state is tiny next to the masked activation
+    batch, so replication is the right fallback. jit cache keys include
+    input shardings: warmup paths (`PatchCleanser.warm_pruned`) apply the
+    same rule so warm placements match live traffic."""
+    if x.shape[0] % mesh.shape[DATA_AXIS] == 0:
+        return place_batch(mesh, x)
+    return jax.device_put(x, replicated(mesh))
+
+
 def place_batch_multihost(mesh: Mesh, x_local, *per_image_local):
     """Multi-host feeding (BASELINE config 5, the v4-32 row): every process
     passes only ITS shard of the global batch; the result is a global

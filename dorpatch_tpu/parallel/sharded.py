@@ -59,12 +59,19 @@ def make_sharded_defenses(
     mesh: Mesh,
     config: DefenseConfig = DefenseConfig(),
     recompile_budget=None,
+    incremental=None,
 ) -> List[PatchCleanser]:
     """The 4-radius defense bank with certification sweeps sharded over the
     mesh (chunk axis splits across chips; the per-chunk forward is the unit
-    of scatter, as in the attack)."""
+    of scatter, as in the attack). The two-phase pruned schedule runs here
+    too: phase 1 shards over the whole mesh, phase-2 worklists are planned
+    shard-locally and dispatched as `[S * bucket]` SPMD waves (see
+    `defense._PrunedPending._schedule_mesh`). `incremental` is the victim
+    family's incremental-inference engine (`models.Victim.incremental`);
+    its programs ride the same shard-local schedule."""
     return build_defenses(shard_apply_fn(apply_fn, mesh), img_size, config,
-                          mesh=mesh, recompile_budget=recompile_budget)
+                          mesh=mesh, recompile_budget=recompile_budget,
+                          incremental=incremental)
 
 
 __all__ = [

@@ -155,14 +155,18 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
             # round up to data.batch_buckets sizes, so the 666-mask sweep
             # compiles once per bucket, not once per surviving batch size.
             # Meshed runs keep exact-batch sweeps: padding would re-lay-out
-            # the sharded input and defeat the place_batch contract.
+            # the sharded input and defeat the place_batch contract. (The
+            # meshed pruned path still buckets its phase-2 worklists — at
+            # its own [S * bucket] shard-local wave shapes, independent of
+            # these image buckets; see defense._PrunedPending._schedule_mesh.)
             cert_buckets = None
             mesh = None
             if cfg.mesh_data * cfg.mesh_mask > 1:
                 mesh = parallel.make_mesh(cfg.mesh_data, cfg.mesh_mask)
                 defenses = parallel.make_sharded_defenses(
                     victim.apply, cfg.img_size, mesh, cfg.defense,
-                    recompile_budget=budget)
+                    recompile_budget=budget,
+                    incremental=victim.incremental)
                 attack = parallel.make_sharded_attack(
                     victim.apply, victim.params, victim.num_classes,
                     cfg.attack, mesh, recompile_budget=budget)
@@ -234,10 +238,7 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                         # size dynamic; shard it over the data axis when it
                         # divides, else replicate (per-image state is tiny
                         # next to the EOT activation batch)
-                        try:
-                            x = parallel.place_batch(mesh, x)
-                        except ValueError:
-                            x = jax.device_put(x, parallel.replicated(mesh))
+                        x = parallel.place_batch_auto(mesh, x)
 
                 with observe.span("artifact_io", op="load_patch"):
                     cached = store.load_patch(i)
@@ -333,8 +334,8 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                         ]
                         # executed vs exhaustive masked-forward accounting
                         # (observe.report derives prune rate / speedup from
-                        # these span attrs; pruning is a no-op on the mesh
-                        # path, where the two totals coincide)
+                        # these span attrs — single-chip and meshed runs
+                        # alike, now that the pruned schedule runs on both)
                         sp_cert["forwards"] = sum(
                             max(0, r.forwards)
                             for recs_d in per_defense for r in recs_d)

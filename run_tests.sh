@@ -81,6 +81,19 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   | grep -q '"parity": true' \
   || { echo "certify-incr smoke: parity/forward-equivalents violation"; exit 1; }
 echo "certify incr smoke: OK"
+# Smoke: sharded pruned certification — the same seeded stub batch through
+# the single-chip pruned oracle, the meshed exhaustive sweep, and the meshed
+# two-phase pruned schedule (phase-2 worklists planned shard-locally,
+# dispatched as [S * bucket] SPMD waves on a 4x2 virtual mesh) must yield
+# bit-identical verdicts, count exactly the oracle's forwards, execute
+# strictly fewer than exhaustive, and the report CLI must render the prune
+# rate from the meshed run dir (tools/certify_mesh_smoke.py exits non-zero
+# and lists the violations otherwise).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/certify_mesh_smoke.py \
+  | grep -q '"parity": true' \
+  || { echo "certify-mesh smoke: parity/forward-count violation"; exit 1; }
+echo "certify mesh smoke: OK"
 # Smoke: fault-tolerant attack-sweep farm — submit a 4-job grid, SIGKILL a
 # chaos worker mid-job after its carry snapshot lands, then drain with two
 # healthy workers: every job must finish, the killed job must show

@@ -647,3 +647,204 @@ def test_resolved_incremental_validation(tiny_vit, tiny_conv):
                       num_mask_per_axis=INCR_AXIS),
         incremental_engine=engine)
     assert multi.resolved_incremental() == "off"
+
+
+# ---------- sharded pruned certification (the mesh fast path) ----------
+#
+# The meshed two-phase schedule (phase 1 sharded over the data axis, one
+# host sync of the [B, 36] table, phase-2 worklists planned PER SHARD and
+# dispatched as fixed [S * bucket] SPMD waves — defense._schedule_mesh)
+# must be invisible in the verdicts: bit-identical records, bit-identical
+# forwards accounting, to the single-chip pruned oracle, regardless of how
+# the worklists skew across shards.
+
+MESH_DATA, MESH_MASK = 4, 2
+
+
+def _mesh_prune_pair(prune="exact", chunk_size=64):
+    from dorpatch_tpu import parallel
+
+    spec = masks_lib.geometry(PRUNE_IMG, 0.1)
+    cfg = DefenseConfig(ratios=(0.1,), prune=prune, chunk_size=chunk_size)
+    oracle = PatchCleanser(_trigger_stub, spec, cfg)
+    mesh = parallel.make_mesh(MESH_DATA, MESH_MASK)
+    sharded = parallel.make_sharded_defenses(
+        _trigger_stub, PRUNE_IMG, mesh, cfg)[0]
+    return oracle, sharded, mesh
+
+
+def test_sharded_pruned_parity_all_verdict_classes():
+    """Meshed pruned verdicts, tables, and forwards are bit-identical to
+    the single-chip pruned oracle on the batch covering every verdict
+    class (one image per data shard: the fully-sharded placement)."""
+    from dorpatch_tpu import parallel
+
+    oracle, sharded, mesh = _mesh_prune_pair()
+    assert sharded.resolved_prune() == "exact"
+    x = _prune_batch()
+    want = oracle.robust_predict(None, x, PRUNE_CLASSES)
+    got = sharded.robust_predict(
+        None, parallel.place_batch_auto(mesh, x), PRUNE_CLASSES)
+    # the batch really covers all four classes (same check as the
+    # single-chip parity test: the fixture must not silently degrade)
+    assert [(w.certification,
+             bool((w.preds_1 == w.preds_1[0]).all())) for w in want] == \
+        [(True, True), (False, False), (False, True), (False, False)]
+    for i, (w, g) in enumerate(zip(want, got)):
+        assert (g.prediction, g.certification) == \
+            (w.prediction, w.certification), f"image {i}"
+        np.testing.assert_array_equal(g.preds_1, w.preds_1)
+        np.testing.assert_array_equal(g.preds_2, w.preds_2)
+        assert g.forwards == w.forwards, f"image {i}"
+        assert g.forward_equivalents == w.forward_equivalents
+
+
+def test_sharded_pruned_forward_counts_law():
+    """The forwards law survives sharding: unanimous images keep the full
+    M + P audit, disagreeing images pay M + k*M for their k minority rows
+    — counted per image, independent of which shard ran the rows."""
+    from dorpatch_tpu import parallel
+
+    oracle, sharded, mesh = _mesh_prune_pair()
+    x = _prune_batch()
+    want = oracle.robust_predict(None, x, PRUNE_CLASSES)
+    got = sharded.robust_predict(
+        None, parallel.place_batch_auto(mesh, x), PRUNE_CLASSES)
+    m, p = sharded.num_first, sharded.num_second
+    for w, g in zip(want, got):
+        k = int((w.preds_1 != np.bincount(
+            w.preds_1, minlength=PRUNE_CLASSES).argmax()).sum())
+        assert g.forwards == (m + p if k == 0 else m + k * m)
+        assert g.forwards == w.forwards
+
+
+def test_sharded_pruned_skewed_worklists():
+    """Worklist skew is a placement detail, not a semantics one. Data-axis
+    ownership is contiguous (np.array_split), so a batch whose only
+    disagreeing images are 0..1 puts EVERY phase-2 row on shard 0 while
+    shards 1..3 plan empty worklists (consensus mode: unanimous images
+    have no phase 2 at all) — verdicts and forwards must still match the
+    single-chip consensus oracle. A 2-image batch (n < S: trailing shards
+    own zero images, placement falls back to replicated rows) must too."""
+    from dorpatch_tpu import parallel
+
+    oracle, sharded, mesh = _mesh_prune_pair("consensus")
+    base = np.asarray(_prune_batch())
+    # images 0,1 = the disagreement classes (recovered / broken-minority);
+    # images 2..7 gray -> consensus-certified with zero phase-2 entries
+    skew = np.full((8, PRUNE_IMG, PRUNE_IMG, 3), 0.5, np.float32)
+    skew[0], skew[1] = base[1], base[3]
+    x = jnp.asarray(skew)
+    want = oracle.robust_predict(None, x, PRUNE_CLASSES)
+    # the fixture really is skewed: all disagreement lives on shard 0
+    disagree = [i for i, w in enumerate(want)
+                if not (w.preds_1 == w.preds_1[0]).all()]
+    assert disagree == [0, 1]
+    got = sharded.robust_predict(
+        None, parallel.place_batch_auto(mesh, x), PRUNE_CLASSES)
+    m = sharded.num_first
+    for i, (w, g) in enumerate(zip(want, got)):
+        assert (g.prediction, g.certification) == \
+            (w.prediction, w.certification), f"image {i}"
+        np.testing.assert_array_equal(g.preds_2, w.preds_2)
+        assert g.forwards == w.forwards, f"image {i}"
+        if i >= 2:
+            assert g.forwards == m  # consensus early exit, no phase 2
+
+    # n=2 < S=4: shards 2,3 own no images at all
+    small = jnp.asarray(skew[:2])
+    want2 = oracle.robust_predict(None, small, PRUNE_CLASSES)
+    got2 = sharded.robust_predict(
+        None, parallel.place_batch_auto(mesh, small), PRUNE_CLASSES)
+    for w, g in zip(want2, got2):
+        assert (g.prediction, g.certification) == \
+            (w.prediction, w.certification)
+        assert g.forwards == w.forwards
+
+
+def test_sharded_pruned_zero_recompile_ragged_worklists():
+    """After `warm_pruned` on the mesh, traffic at the warmed batch
+    buckets with arbitrary verdict-class mixes (and thus ragged per-shard
+    phase-2 worklists) shares the per-rung compiled programs: trace counts
+    identical before and after, under the ARMED recompile watchdog."""
+    from dorpatch_tpu import parallel
+    from dorpatch_tpu.analysis.sanitize import Sanitizer
+
+    spec = masks_lib.geometry(PRUNE_IMG, 0.1)
+    buckets = (4, 8)
+    mesh = parallel.make_mesh(MESH_DATA, MESH_MASK)
+    pc = parallel.make_sharded_defenses(
+        _trigger_stub, PRUNE_IMG, mesh,
+        DefenseConfig(ratios=(0.1,), prune="exact", chunk_size=8),
+        recompile_budget=len(buckets))[0]
+    pc.warm_pruned(None, buckets)
+    warm = pc.pruned_trace_counts()
+    r = spec.patch_ratio
+    assert warm[f"defense.phase1.mesh.r{r}"] == len(buckets)
+    # meshes dispatch the pair audit at wave shapes over the row ladder
+    assert warm[f"defense.rows.mesh.r{r}"] == len(pc.row_bucket_sizes)
+    assert warm[f"defense.pairs.mesh.r{r}"] == len(pc.row_bucket_sizes)
+    base = np.asarray(_prune_batch())
+    with Sanitizer(debug_nans=False, log_compiles=False):
+        for n, shift in ((4, 0), (8, 1), (4, 2), (8, 3)):
+            idx = np.asarray([(i + shift) % 4 for i in range(n)])
+            recs = pc.robust_predict(
+                None, parallel.place_batch_auto(mesh, jnp.asarray(base[idx])),
+                PRUNE_CLASSES)
+            assert len(recs) == n
+    assert pc.pruned_trace_counts() == warm
+
+
+@pytest.mark.parametrize("family", ["vit", "conv"])
+def test_sharded_incremental_parity(tiny_vit, tiny_conv, family):
+    """Incremental engines ride the shard-local schedule: the meshed
+    token-pruned ViT (token-exact) and stem-folded conv certifiers are
+    record-for-record identical — verdicts, forwards, and fractional
+    forward-equivalents — to their single-chip incremental counterparts."""
+    from dorpatch_tpu import parallel
+
+    params, apply_fn, engine = tiny_vit if family == "vit" else tiny_conv
+    mode = "token-exact" if family == "vit" else "stem"
+    _, incr = _incr_pair(apply_fn, engine, 0.1)
+    mesh = parallel.make_mesh(MESH_DATA, MESH_MASK)
+    spec = masks_lib.geometry(INCR_IMG, 0.1, num_mask_per_axis=INCR_AXIS)
+    sh = PatchCleanser(
+        parallel.shard_apply_fn(apply_fn, mesh), spec,
+        DefenseConfig(ratios=(0.1,), prune="exact",
+                      num_mask_per_axis=INCR_AXIS),
+        mesh=mesh, incremental_engine=engine)
+    assert sh.resolved_incremental() == mode
+    x = _incr_batch()
+    want = incr.robust_predict(params, x, INCR_CLASSES, bucket_sizes=(1, 4))
+    got = sh.robust_predict(params, parallel.place_batch_auto(mesh, x),
+                            INCR_CLASSES)
+    for i, (w, g) in enumerate(zip(want, got)):
+        assert (g.prediction, g.certification) == \
+            (w.prediction, w.certification), f"image {i}"
+        np.testing.assert_array_equal(g.preds_1, w.preds_1)
+        assert g.forwards == w.forwards, f"image {i}"
+        assert abs(g.forward_equivalents - w.forward_equivalents) < 1e-9
+
+
+def test_sharded_prune_downgrade_event_once(tmp_path):
+    """n_patch != 1 families still have no pruned programs on the mesh:
+    resolved_prune downgrades to "off" and emits the
+    `defense.prune_downgrade` observe event exactly once per certifier."""
+    import json
+
+    from dorpatch_tpu import observe, parallel
+
+    mesh = parallel.make_mesh(MESH_DATA, MESH_MASK)
+    spec = masks_lib.geometry(PRUNE_IMG, 0.1, n_patch=2)
+    pc = PatchCleanser(
+        parallel.shard_apply_fn(_trigger_stub, mesh), spec,
+        DefenseConfig(ratios=(0.1,), prune="exact", n_patch=2), mesh=mesh)
+    log = tmp_path / "events.jsonl"
+    with observe.EventLog(str(log), run_id="downgrade-test") as el, \
+            observe.active(el):
+        assert pc.resolved_prune() == "off"
+        assert pc.resolved_prune() == "off"  # second resolve: no re-emit
+    events = [json.loads(l) for l in log.read_text().splitlines()]
+    hits = [e for e in events
+            if e.get("name") == "defense.prune_downgrade"]
+    assert len(hits) == 1

@@ -101,14 +101,15 @@ def test_sharded_defense_matches_unsharded():
     x = jax.random.uniform(jax.random.PRNGKey(7), (3, 32, 32, 3))
 
     ref = build_defenses(_toy_apply, 32, dcfg)[0]
-    # full-table comparison needs the exhaustive schedule; the meshed path
-    # always runs it (resolved_prune forces "off" under a mesh)
+    # full-table comparison needs the exhaustive schedule on BOTH sides —
+    # explicit prune="off" (the meshed path runs the pruned schedule by
+    # default now, same as single-chip)
     ref_records = ref.robust_predict(None, x, 4, prune="off")
 
     mesh = make_mesh(1, 8)
     sh = make_sharded_defenses(_toy_apply, 32, mesh, dcfg)[0]
-    sh_records = sh.robust_predict(None, jax.device_put(x, parallel.replicated(mesh)), 4)
-    assert sh.resolved_prune() == "off"
+    xs = jax.device_put(x, parallel.replicated(mesh))
+    sh_records = sh.robust_predict(None, xs, 4, prune="off")
 
     for a, b in zip(ref_records, sh_records):
         assert a.prediction == b.prediction
@@ -116,16 +117,32 @@ def test_sharded_defense_matches_unsharded():
         np.testing.assert_array_equal(a.preds_1, b.preds_1)
         np.testing.assert_array_equal(a.preds_2, b.preds_2)
 
-    # the pruned default agrees with the meshed verdicts wherever it
-    # evaluated the table (bit-identical verdicts, sparse preds_2)
-    pruned_records = ref.robust_predict(None, x, 4)
+    # the meshed pruned DEFAULT agrees with the exhaustive meshed verdicts
+    # wherever it evaluated the table (bit-identical verdicts, sparse
+    # preds_2) — test_defense.py's sharded-pruned section holds the full
+    # parity/forwards contract against the single-chip pruned oracle
+    pruned_records = sh.robust_predict(None, xs, 4)
     for a, b in zip(pruned_records, sh_records):
         assert a.prediction == b.prediction
         assert a.certification == b.certification
         np.testing.assert_array_equal(a.preds_1, b.preds_1)
-        evaluated = a.preds_2 >= 0
-        np.testing.assert_array_equal(a.preds_2[evaluated],
+        evaluated = np.asarray(a.preds_2) >= 0
+        np.testing.assert_array_equal(np.asarray(a.preds_2)[evaluated],
                                       np.asarray(b.preds_2)[evaluated])
+
+
+def test_mesh_certify_resolves_pruned():
+    """The mesh restriction is gone: a sharded certifier resolves the
+    pruned fast path (and the incremental rider) exactly like single-chip
+    — no silent downgrade to the exhaustive schedule."""
+    sh = make_sharded_defenses(
+        _toy_apply, 32, make_mesh(2, 4),
+        DefenseConfig(ratios=(0.06,), prune="exact", chunk_size=16))[0]
+    assert sh.resolved_prune() == "exact"
+    assert sh.resolved_prune("consensus") == "consensus"
+    # phase-2 programs exist and plan at [S * bucket] wave shapes
+    assert sh.row_bucket_sizes
+    assert sh.mesh is not None
 
 
 @pytest.mark.slow

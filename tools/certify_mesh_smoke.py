@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Certify-mesh smoke: the sharded pruned certification path on an
+8-device virtual CPU mesh (CI gate, `run_tests.sh`).
+
+Runs the seeded stub batch of `certify_prune_smoke` through three
+certifiers — the single-chip pruned oracle, the meshed exhaustive sweep,
+and the meshed two-phase pruned schedule (phase 1 sharded over the data
+axis, phase-2 worklists planned shard-locally and dispatched as
+`[S * bucket]` SPMD waves; `defense._PrunedPending._schedule_mesh`) on a
+(data=4, mask=2) mesh — then asserts:
+
+- verdict parity: (prediction, certification) bit-identical across all
+  three, first-round tables equal, and every double-masked entry the
+  meshed pruned path DID evaluate matches the meshed exhaustive table;
+- forwards accounting: the meshed pruned run counts exactly the
+  single-chip pruned oracle's forwards, strictly fewer than exhaustive;
+- the report CLI renders the prune rate from a run dir whose certify
+  span carries the meshed run's forwards/forwards_exhaustive attrs.
+
+Prints ONE JSON line: {"metric": "certify_mesh_smoke", "parity": true,
+"mesh": "4x2", ...}; exits non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# before any jax import: the mesh needs 8 virtual CPU devices
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from dorpatch_tpu import masks as masks_lib, observe, parallel
+    from dorpatch_tpu.config import DefenseConfig
+    from dorpatch_tpu.defense import PatchCleanser
+
+    img, n_classes = 32, 2
+
+    def stub(params, x):
+        # weightless trigger detector (certify_prune_smoke's): class 1 iff
+        # the 4x4 region at (20:24, 20:24) is mostly bright — only masks
+        # occluding the whole trigger flip it, so those masks form a small
+        # genuine first-round minority (the pruned second round's target)
+        score = x[:, 20:24, 20:24, :].mean(axis=(1, 2, 3))
+        return jnp.stack([0.7 - score, score - 0.7], axis=-1)
+
+    rng = np.random.default_rng(1234)
+    imgs = np.full((6, img, img, 3), 0.2, np.float32)
+    imgs += rng.uniform(0.0, 0.05, imgs.shape).astype(np.float32)
+    imgs[0] = 0.5  # gray: provably first-round unanimous (and certified)
+    imgs[3, 20:24, 20:24, :] = 1.0  # planted triggers: first-round
+    imgs[4, 20:24, 20:24, :] = 1.0  # disagreement -> pruned second round
+    x = jnp.asarray(imgs)
+
+    spec = masks_lib.geometry(img, 0.1)
+    oracle = PatchCleanser(stub, spec,
+                           DefenseConfig(ratios=(0.1,), prune="exact"))
+    mesh = parallel.make_mesh(4, 2)
+    cfg = DefenseConfig(ratios=(0.1,), prune="exact")
+    sharded = parallel.make_sharded_defenses(stub, img, mesh, cfg)[0]
+
+    failures = []
+    if sharded.resolved_prune() != "exact":
+        failures.append("meshed certifier downgraded prune "
+                        f"to {sharded.resolved_prune()!r}")
+    xm = parallel.place_batch_auto(mesh, x)
+    want = oracle.robust_predict(None, x, n_classes, bucket_sizes=(1, 8))
+    got = sharded.robust_predict(None, xm, n_classes)
+    exh = sharded.robust_predict(None, xm, n_classes, prune="off")
+
+    for i, (w, g, e) in enumerate(zip(want, got, exh)):
+        if not (w.prediction == g.prediction == e.prediction) or \
+                not (w.certification == g.certification == e.certification):
+            failures.append(
+                f"image {i}: verdicts diverge — single-chip pruned "
+                f"({w.prediction}, {w.certification}), meshed pruned "
+                f"({g.prediction}, {g.certification}), meshed exhaustive "
+                f"({e.prediction}, {e.certification})")
+        if not (np.array_equal(w.preds_1, g.preds_1)
+                and np.array_equal(np.asarray(e.preds_1), g.preds_1)):
+            failures.append(f"image {i}: first-round tables differ")
+        evaluated = g.preds_2 >= 0
+        if not np.array_equal(np.asarray(e.preds_2)[evaluated],
+                              g.preds_2[evaluated]):
+            failures.append(f"image {i}: evaluated second-round entries "
+                            "differ from the meshed exhaustive table")
+        if w.forwards != g.forwards:
+            failures.append(f"image {i}: meshed pruned counted "
+                            f"{g.forwards} forwards, single-chip oracle "
+                            f"{w.forwards}")
+
+    fwd = sum(r.forwards for r in got)
+    exhaustive = sum(r.forwards for r in exh)
+    if not fwd < exhaustive:
+        failures.append(f"no pruning on the mesh: executed {fwd} vs "
+                        f"exhaustive {exhaustive}")
+
+    # the report CLI must derive the prune rate from a meshed run's
+    # certify span (the attrs pipeline.py records on both paths)
+    run_dir = tempfile.mkdtemp(prefix="certify_mesh_smoke_")
+    try:
+        with observe.EventLog(os.path.join(run_dir, "events.jsonl"),
+                              run_id="certify-mesh-smoke") as el:
+            with el.span("run"):
+                with el.span("certify", images=len(got)) as sp:
+                    sp["forwards"] = int(fwd)
+                    sp["forward_equivalents"] = float(sum(
+                        r.forward_equivalents for r in got))
+                    sp["forwards_exhaustive"] = int(exhaustive)
+        rendered = subprocess.run(
+            [sys.executable, "-m", "dorpatch_tpu.observe.report", run_dir],
+            capture_output=True, text=True, timeout=120)
+        if rendered.returncode != 0:
+            failures.append("report CLI failed on the mesh run dir: "
+                            + rendered.stderr[-500:])
+        elif "prune rate" not in rendered.stdout:
+            failures.append("report CLI did not render the prune rate "
+                            "for the mesh run")
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "certify_mesh_smoke",
+        "parity": not failures,
+        "mesh": "4x2",
+        "images": len(got),
+        "forwards": int(fwd),
+        "forwards_exhaustive": int(exhaustive),
+        "prune_rate": round(1.0 - fwd / exhaustive, 4),
+        "failures": failures,
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
