@@ -54,6 +54,11 @@ BENCH_INCR (certify mode: off|on|ab — mask-aware incremental forwards; "ab"
 times the incremental engine vs the PR 5 pruned-only path on the same batch,
 asserts parity per the family's exactness contract, and prints incr_speedup
 plus forward_equivalents_per_image — see `_certify_bench`),
+BENCH_KERNEL (certify mode: on|off|ab, default "on" — the Pallas kernel
+tier's use_pallas gate; "ab" times the engine-backed certify with kernels
+off vs the production gate, asserts the kernels' exactness contracts, and
+prints kernel_speedup plus each side's static bytes-accessed and
+flops/byte — see `_certify_bench`),
 BENCH_TORCH_TIMEOUT (default 600), BENCH_TOTAL_BUDGET (seconds, default
 3000 — a hard wall budget across ALL children; every child's timeout is
 clipped so the orchestrator always prints its JSON line before an outer
@@ -313,19 +318,30 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
 
     BENCH_INCR selects the mask-aware incremental forwards
     (DefenseConfig.incremental; "off" default): "on" runs the family's
-    resolved engine (token-pruned ViT / masked-stem conv); "ab" times the
-    incremental path vs the PR 5 pruned-only path on the same batch,
-    asserts parity — bit-exact for the exact-contract families (stem); for
-    the tolerance-contracted token family every verdict mismatch must have
-    been margin-flagged (its min evaluated top-2 logit gap below
-    DefenseConfig.incremental_margin, i.e. the escalation signal
-    token-exact acts on caught it) — and prints `incr_speedup` plus
+    resolved engine (token-pruned ViT / mixer-pruned ResMLP / masked-stem
+    conv); "ab" times the incremental path vs the PR 5 pruned-only path on
+    the same batch, asserts parity — bit-exact for the exact-contract
+    families (stem); for the tolerance-contracted token/mixer families
+    every verdict mismatch must have been margin-flagged (its min
+    evaluated top-2 logit gap below DefenseConfig.incremental_margin,
+    i.e. the escalation signal the "-exact" modes act on caught it) —
+    and prints `incr_speedup` plus
     `forward_equivalents_per_image`, the mandatory first-round sweep's
     per-image cost in full-forward units (36.0 un-pruned; every certified
     image pays this floor). `forward_equivalents_total_per_image` is the
     whole certify's fractional cost, and MFU credits fractional forwards.
     Incremental engines run the f32 params path (bf16 requests fall back,
     logged).
+
+    BENCH_KERNEL gates the Pallas kernel tier (DefenseConfig.use_pallas;
+    "on" default = the production "auto" gate): "off" pins the XLA tier,
+    "ab" times the engine-backed pruned certify with kernels off vs the
+    production gate on the same batch — verdict parity asserted per the
+    kernels' exactness contracts (stem bit-exact at CPU f32, attention
+    margin-contracted) — and prints `kernel_speedup` plus each side's
+    static bytes-accessed and flops/byte (`kernel_roofline`, from the
+    baseline cost model over the phase-1 jaxpr; on CPU the gate resolves
+    off so the timed sides match and the row is a no-regression floor).
 
     BENCH_MESH="DxM" (e.g. "4x2") runs the whole certify on a (data=D,
     mask=M) device mesh: the exhaustive sweep shards as before, the pruned
@@ -343,6 +359,11 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
 
     prune = os.environ.get("BENCH_PRUNE") or "exact"
     incr = os.environ.get("BENCH_INCR") or "off"
+    kern = os.environ.get("BENCH_KERNEL") or "on"
+    # "on" is the production gate (DefenseConfig default "auto": kernels
+    # resolve on where the backend supports them); "off" forces the XLA
+    # tier everywhere, so timed rows can pin either side of the A/B.
+    kern_gate = {"on": "auto", "off": "off"}.get(kern, "auto")
     mesh = None
     mesh_env = os.environ.get("BENCH_MESH") or ""
     if mesh_env:
@@ -370,9 +391,10 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
             return victim.apply(params16, xx.astype(jnp.bfloat16)).astype(
                 jnp.float32)
 
-    def make_defense(mode, incremental="off"):
+    def make_defense(mode, incremental="off", use_pallas=None):
         cfg = DefenseConfig(ratios=(0.06,), chunk_size=128, prune=mode,
-                            incremental=incremental)
+                            incremental=incremental,
+                            use_pallas=use_pallas or kern_gate)
         engine = victim.incremental if incremental != "off" else None
         if mesh is not None:
             return parallel.make_sharded_defenses(
@@ -405,8 +427,8 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
 
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
 
-    def time_mode(mode, xx, incremental="off"):
-        d = make_defense(mode, incremental)
+    def time_mode(mode, xx, incremental="off", use_pallas=None):
+        d = make_defense(mode, incremental, use_pallas)
         if mesh is not None:
             # sharded over the data axis when it divides the batch; the
             # eager refresh arithmetic below preserves the placement
@@ -462,28 +484,100 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
             "parity": mismatches == 0,
             "parity_mismatches": mismatches,
         })
+    elif kern == "ab":
+        # kernel-tier A/B: the SAME engine-backed pruned schedule on both
+        # sides, differing only in DefenseConfig.use_pallas ("off" pins
+        # the XLA tier, "auto" is the production gate — kernels on where
+        # the backend supports them, so on CPU both sides lower
+        # identically and the row is a no-regression floor). Parity uses
+        # the engines' own exactness contracts: the stem kernel shares
+        # its delta-conv with the fold (bit-exact, hard-fail at CPU f32),
+        # the attention kernel is tolerance-contracted like the engine
+        # itself (mismatches must be margin-flagged).
+        kind = getattr(victim.incremental, "kind", None)
+        if kind is None:
+            raise AssertionError(
+                f"BENCH_KERNEL=ab but arch {arch!r} resolved no "
+                "incremental engine — pick a ViT/ResMLP/conv family")
+        raw_mode = {"token": "token", "mixer": "mixer"}.get(kind, "auto")
+        base_prune = "exact" if prune == "off" else prune
+        d_off, x_final, dt_off, recs_off = time_mode(
+            base_prune, x, incremental=raw_mode, use_pallas="off")
+        d, _, dt, recs = time_mode(
+            base_prune, x, incremental=raw_mode, use_pallas="auto")
+        incr_mode = d.resolved_incremental(raw_mode)
+        mism = [i for i, (a, b) in enumerate(zip(recs_off, recs))
+                if (a.prediction, a.certification) != (b.prediction,
+                                                       b.certification)]
+        if incr_mode == "stem":
+            if mism and jax.default_backend() == "cpu" \
+                    and dtype == "float32":
+                raise AssertionError(
+                    f"stem kernel verdict parity broke on {len(mism)} "
+                    f"image(s) at f32 on cpu — a kernel bug, not numerics")
+        else:
+            tol = d.config.incremental_margin
+            unflagged = [i for i in mism
+                         if d.last_min_margin[i] >= tol]
+            if unflagged:
+                raise AssertionError(
+                    f"kernel tier flipped {len(unflagged)} verdict(s) at "
+                    f"margins >= {tol} — tolerance contract violated")
+        prune_stats.update({
+            "incr": incr_mode,
+            "kernel": "ab",
+            "kernel_speedup": round(dt_off / dt, 3),
+            "parity": not mism,
+            "parity_mismatches": len(mism),
+        })
+        # roofline axis for the A/B: static bytes-accessed + arithmetic
+        # intensity of the engine's phase-1 program under each gate
+        # ("interpret" keeps the pallas_call eqns in the jaxpr so the
+        # fused-cost model prices them even on CPU; "off" is the XLA
+        # lowering). Estimate-only — failure just omits the numbers.
+        try:
+            import numpy as np
+
+            from dorpatch_tpu import masks as masks_lib
+            from dorpatch_tpu.analysis import baseline as baseline_lib
+
+            spec = masks_lib.geometry(img, 0.06)
+            singles, doubles = masks_lib.mask_sets(spec)
+            kk = max(singles.shape[1], doubles.shape[1])
+            rects = np.concatenate([masks_lib.pad_rects(singles, kk),
+                                    masks_lib.pad_rects(doubles, kk)])
+            ai = {}
+            for gate, tag in (("interpret", "kernel"), ("off", "xla")):
+                fam = victim.incremental.build_family(
+                    rects, singles.shape[0], 128, 0.5, use_pallas=gate)
+                jaxpr = jax.make_jaxpr(fam.phase1)(victim.params, x)
+                cost = baseline_lib.estimate_cost(jaxpr)
+                ai[tag] = {"est_bytes": round(cost["est_bytes"], 1),
+                           "flops_per_byte": round(cost["est_ai"], 3)}
+            prune_stats["kernel_roofline"] = ai
+        except Exception as e:  # noqa: BLE001 - reporting axis only
+            log(f"kernel roofline estimate unavailable ({e})")
     elif incr == "ab":
         # incremental A/B rides the production pruned schedule on both
         # sides: PR 5's pruned-only path vs the same schedule with the
-        # family engine's incremental forwards. For ViT families the
-        # timed side is the RAW "token" engine — the production default
-        # ("token-exact") adds margin-gated escalation whose cost depends
-        # on the victim's margin distribution (the bench's random-init
-        # victim is the documented escalate-everything worst case), and
-        # its exactness mechanism is covered by the margin-flag assertion
-        # below plus the token-exact parity fixtures in tests.
+        # family engine's incremental forwards. For the row-set engines
+        # (ViT "token", ResMLP "mixer") the timed side is the RAW engine
+        # mode — the production default ("token-exact"/"mixer-exact")
+        # adds margin-gated escalation whose cost depends on the victim's
+        # margin distribution (the bench's random-init victim is the
+        # documented escalate-everything worst case), and its exactness
+        # mechanism is covered by the margin-flag assertion below plus
+        # the -exact parity fixtures in tests.
         base_prune = "exact" if prune == "off" else prune
         kind = getattr(victim.incremental, "kind", None)
         if kind is None:
             raise AssertionError(
                 f"BENCH_INCR=ab but arch {arch!r} resolved no incremental "
-                "engine — pick a ViT/conv family")
+                "engine — pick a ViT/ResMLP/conv family")
+        raw_mode = {"token": "token", "mixer": "mixer"}.get(kind, "auto")
         d_off, x_final, dt_off, recs_off = time_mode(base_prune, x)
-        d, _, dt, recs = time_mode(
-            base_prune, x,
-            incremental="token" if kind == "token" else "auto")
-        incr_mode = d.resolved_incremental(
-            "token" if kind == "token" else "auto")
+        d, _, dt, recs = time_mode(base_prune, x, incremental=raw_mode)
+        incr_mode = d.resolved_incremental(raw_mode)
         mism = [i for i, (a, b) in enumerate(zip(recs_off, recs))
                 if (a.prediction, a.certification) != (b.prediction,
                                                        b.certification)]
@@ -496,19 +590,20 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
                     f"stem-fold verdict parity broke on {len(mism)} "
                     f"image(s) at f32 on cpu — a fold bug, not numerics")
         else:
-            # token parity is tolerance-contracted: every mismatch must
-            # have been margin-flagged (min evaluated top-2 logit gap
-            # below incremental_margin — the signal "token-exact" uses to
-            # escalate; read off the timed run's own pending). A
-            # high-margin mismatch means drift exceeded the documented
-            # tolerance: fail.
+            # row-set (token/mixer) parity is tolerance-contracted: every
+            # mismatch must have been margin-flagged (min evaluated top-2
+            # logit gap below incremental_margin — the signal the "-exact"
+            # modes use to escalate; read off the timed run's own
+            # pending). A high-margin mismatch means drift exceeded the
+            # documented tolerance: fail.
             tol = d.config.incremental_margin
             unflagged = [i for i in mism
                          if d.last_min_margin[i] >= tol]
             if unflagged:
                 raise AssertionError(
-                    f"token drift flipped {len(unflagged)} verdict(s) at "
-                    f"margins >= {tol} — tolerance contract violated")
+                    f"{incr_mode} drift flipped {len(unflagged)} "
+                    f"verdict(s) at margins >= {tol} — tolerance contract "
+                    "violated")
         prune_stats.update({
             "incr": incr_mode,
             "ips_pruned_only": round(batch / dt_off, 4),
@@ -863,6 +958,23 @@ def main() -> None:
                           "error": f"unknown BENCH_INCR={bi!r} (use 'off', "
                                    "'on' or 'ab')"}))
         return
+    bk = os.environ.get("BENCH_KERNEL") or "on"
+    if bk not in ("off", "on", "ab"):
+        print(json.dumps({"metric": err_metric, "value": 0.0,
+                          "unit": "images/sec", "vs_baseline": 0.0,
+                          "error": f"unknown BENCH_KERNEL={bk!r} (use "
+                                   "'off', 'on' or 'ab')"}))
+        return
+    if bk == "ab" and (bp == "ab" or bi != "off"):
+        # the kernel A/B fixes the schedule (engine-backed pruned certify)
+        # and varies only the use_pallas gate; stacking a second A/B axis
+        # would make the reported speedup unattributable
+        print(json.dumps({"metric": err_metric, "value": 0.0,
+                          "unit": "images/sec", "vs_baseline": 0.0,
+                          "error": "BENCH_KERNEL=ab measures the kernel-"
+                                   "tier axis alone; set BENCH_INCR=off "
+                                   "and drop BENCH_PRUNE=ab"}))
+        return
     bm = os.environ.get("BENCH_MESH") or ""
     if bm:
         parts = bm.split("x")
@@ -992,7 +1104,8 @@ def main() -> None:
               "prune_rate", "ips_exhaustive", "prune_speedup", "parity",
               "parity_mismatches", "incr", "incr_speedup", "ips_pruned_only",
               "forward_equivalents_per_image",
-              "forward_equivalents_total_per_image", "mesh"):
+              "forward_equivalents_total_per_image", "mesh",
+              "kernel", "kernel_speedup", "kernel_roofline"):
         if res.get(k) is not None:
             out[k] = res[k]
     if fallback is not None:

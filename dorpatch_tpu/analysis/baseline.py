@@ -368,15 +368,22 @@ def _eqn_bytes(eqn) -> float:
 
 
 def estimate_cost(closed_or_raw) -> Dict[str, Any]:
-    """Pure jaxpr-walk static cost: flops, boundary bytes, per-primitive
-    flops breakdown. Scan bodies are multiplied by trip count; `cond`
-    branches are summed (a conservative upper bound); `while` bodies count
-    once (trip count is unknowable statically — documented, not guessed)."""
+    """Pure jaxpr-walk static cost: flops, boundary bytes, arithmetic
+    intensity (flops/byte — the roofline axis: low means bandwidth-bound),
+    per-primitive flops breakdown. Scan bodies are multiplied by trip
+    count; `cond` branches are summed (a conservative upper bound);
+    `while` bodies count once (trip count is unknowable statically —
+    documented, not guessed). `pallas_call` equations are costed as fused
+    kernels: the inner jaxpr's arithmetic times the grid, but only the
+    call-boundary operands/results as bytes — kernel intermediates live
+    in VMEM, which is exactly the traffic reduction the kernel tier
+    exists to show."""
     acc = _CostAcc()
     _walk_cost(closed_or_raw, 1.0, acc)
     by_prim = dict(sorted(acc.by_primitive.items(),
                           key=lambda kv: (-kv[1], kv[0]))[:TOP_K_PRIMITIVES])
     return {"est_flops": acc.flops, "est_bytes": acc.bytes,
+            "est_ai": acc.flops / max(acc.bytes, 1.0),
             "primitives": by_prim}
 
 
@@ -384,6 +391,22 @@ def _walk_cost(j, mult: float, acc: _CostAcc) -> None:
     for eqn in _raw(j).eqns:
         prim = eqn.primitive.name
         subs = program_mod._eqn_subjaxprs(eqn)
+        if prim == "pallas_call" and subs:
+            inner = _CostAcc()
+            for s in subs:
+                _walk_cost(s, 1.0, inner)
+            steps = 1.0
+            gm = eqn.params.get("grid_mapping")
+            for d in (getattr(gm, "grid", ()) or ()):
+                try:
+                    steps *= float(int(d))
+                except (TypeError, ValueError):
+                    pass
+            f = inner.flops * steps * mult
+            acc.flops += f
+            acc.bytes += _eqn_bytes(eqn) * mult
+            acc.by_primitive[prim] = acc.by_primitive.get(prim, 0.0) + f
+            continue
         if subs:
             sub_mult = mult
             if prim == "scan":
@@ -442,6 +465,9 @@ def snapshot_entrypoint(ep: EntryPoint, compiled: bool = True
     est = estimate_cost(ctx.jaxpr)
     entry["cost"]["est_flops"] = est["est_flops"]
     entry["cost"]["est_bytes"] = est["est_bytes"]
+    # derived, NOT in _COST_METRICS: flops and bytes already gate DP301,
+    # and a ratio of gated metrics would double-report every regression
+    entry["cost"]["est_ai"] = est["est_ai"]
     entry["primitives"] = est["primitives"]
     if compiled and getattr(ctx, "traced", None) is not None:
         cc = compiled_cost(ctx.traced)
@@ -731,6 +757,22 @@ def check_summary(findings: List[Finding], entries: int,
     by_rule: Dict[str, int] = {}
     for f in findings:
         by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
+    # bandwidth profile: the heaviest entries by estimated boundary bytes
+    # with their arithmetic intensity (flops/byte) — the roofline column
+    # the report renders, so kernel-tier traffic reductions are visible
+    # without opening baselines.json
+    intensity = []
+    for name, e in data.get("entries", {}).items():
+        cost = e.get("cost", {}) or {}
+        fl, by = cost.get("est_flops"), cost.get("est_bytes")
+        if fl is None or by is None:
+            continue
+        intensity.append({
+            "name": name, "est_flops": float(fl), "est_bytes": float(by),
+            "est_ai": float(cost.get("est_ai",
+                                     float(fl) / max(float(by), 1.0))),
+        })
+    intensity.sort(key=lambda r: (-r["est_bytes"], r["name"]))
     return {
         "entries": entries,
         "baseline_file": str(path),
@@ -741,4 +783,5 @@ def check_summary(findings: List[Finding], entries: int,
         "findings": [
             {"rule": f.rule_id, "path": f.path, "line": f.line,
              "message": f.message} for f in findings],
+        "intensity": intensity[:8],
     }

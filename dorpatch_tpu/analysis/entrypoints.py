@@ -311,7 +311,8 @@ def _enumerate_incremental() -> None:
     """The mask-aware incremental certify programs (DefenseConfig.
     incremental): one bank per engine family — the token-pruned ViT
     programs on the small ViT victim, the stem-folded conv phase 1 on the
-    conv victim — at one representative radius (0.06, shared with the
+    conv victim, the mixer-pruned ResMLP programs on the small ResMLP
+    victim — at one representative radius (0.06, shared with the
     standard bank so the per-radius wrapper names stay covered). The
     engines' lookup tables are closed-over DEVICE arrays (the params idiom
     DP203 exempts); registration attaches abstract args only, nothing
@@ -328,7 +329,7 @@ def _enumerate_incremental() -> None:
         (1, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
     imgs = jax.ShapeDtypeStruct(
         (AUDIT_BATCH, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
-    for arch in ("cifar_vit", "cifar_resnet18"):
+    for arch in ("cifar_vit", "cifar_resnet18", "cifar_resmlp"):
         model = registry.build_bare_model(arch, AUDIT_CLASSES)
         engine = registry.incremental_engine(arch, model, AUDIT_IMG_SIZE)
 
@@ -429,6 +430,48 @@ def _enumerate_serve(apply_fn, params) -> None:
             register_bucket_ladder(d._rows_incr._name, d.row_bucket_sizes)
 
 
+def _enumerate_kernel_tier() -> None:
+    """Audit-only kernel-tier probes: the stem and token engines' phase-1
+    programs with the Pallas gate forced to "interpret" (abstract tracing
+    keeps the `pallas_call` equations on any backend), registered next to
+    their pure-XLA twins. The baseline then carries BOTH cost vectors —
+    the jaxpr-walk estimator costs `pallas_call` as a fused kernel
+    (boundary bytes only), so the kernels' bytes-accessed reduction over
+    the einsum/conv chains is a checked DP301 number, not a claim."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dorpatch_tpu import masks as masks_lib
+    from dorpatch_tpu.models import registry
+
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    dummy = jax.ShapeDtypeStruct(
+        (1, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    imgs = jax.ShapeDtypeStruct(
+        (AUDIT_BATCH, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    spec = masks_lib.geometry(AUDIT_IMG_SIZE, 0.06)
+    singles, doubles = masks_lib.mask_sets(spec)
+    k = max(singles.shape[1], doubles.shape[1])
+    rects = np.concatenate([masks_lib.pad_rects(singles, k),
+                            masks_lib.pad_rects(doubles, k)], axis=0)
+    for arch, kname in (("cifar_resnet18", "stem"), ("cifar_vit", "token")):
+        model = registry.build_bare_model(arch, AUDIT_CLASSES)
+        engine = registry.incremental_engine(arch, model, AUDIT_IMG_SIZE)
+        params_abs = abstractify(jax.eval_shape(model.init, key, dummy))
+        for mode in ("interpret", "off"):
+            fam = engine.build_family(rects, singles.shape[0], 64, 0.5,
+                                      use_pallas=mode)
+            tag = "kernel" if mode == "interpret" else "xla"
+            # noqa-reason: audit-only probe programs, never executed —
+            # there is no run for their compile time to be accounted
+            # against
+            register_entrypoint(
+                jax.jit(fam.phase1),  # noqa: DP105
+                (params_abs, imgs),
+                name=f"ops.kernel_tier.{kname}.phase1.{tag}")
+
+
 def _enumerate_sharded_ops() -> None:
     """The multichip dry-run path: the Pallas masked-fill gradient under
     `shard_map`, whose backward `psum`s over the mask axis — the one
@@ -480,6 +523,7 @@ def production_entrypoints(clear: bool = True) -> List[EntryPoint]:
         _enumerate_train()
         _enumerate_model_init()
         _enumerate_serve(apply_fn, params)
+        _enumerate_kernel_tier()
         _enumerate_sharded_ops()
         _enumerate_sharded_defense(apply_fn, params)
     return registered_entrypoints()

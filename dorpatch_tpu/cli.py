@@ -124,7 +124,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="alias for --prune off (the exhaustive parity "
                         "oracle)")
     p.add_argument("--incremental", default="auto",
-                   choices=["auto", "token", "token-exact", "stem", "off"],
+                   choices=["auto", "token", "token-exact", "mixer",
+                            "mixer-exact", "stem", "off"],
                    help="mask-aware incremental masked forwards on the "
                         "pruned certify path: 'auto' (default) picks per "
                         "family — 'token-exact' for ViT victims "
@@ -134,13 +135,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "--incremental-margin of the decision boundary "
                         "through the exhaustive program, so verdicts stay "
                         "bit-identical under the documented drift "
-                        "tolerance) or the exact conv masked-stem fold "
-                        "('stem'); plain 'token' opts into "
+                        "tolerance), 'mixer-exact' for ResMLP victims "
+                        "(dirty-row tracking through a skinny slice of "
+                        "the token-mixing matmul, same margin contract), "
+                        "or the exact conv masked-stem fold "
+                        "('stem'); plain 'token'/'mixer' opt into "
                         "tolerance-contracted verdicts with no "
                         "escalation; 'off' = full masked forwards for "
                         "every scheduled entry")
     p.add_argument("--incremental-margin", type=float, default=0.5,
-                   help="token-exact escalation threshold: top-2 logit gap "
+                   help="token/mixer-exact escalation threshold: top-2 "
+                        "logit gap "
                         "below which an incremental table entry is "
                         "distrusted and its image re-certified through the "
                         "exhaustive program")

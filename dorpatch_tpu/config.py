@@ -96,7 +96,13 @@ class DefenseConfig:
     num_mask_per_axis: int = NUM_MASKS_PER_AXIS
     mask_fill: float = 0.5          # gray fill (PatchCleanser.py:100)
     chunk_size: int = 64            # certification sweep chunking (PatchCleanser.py:102)
-    use_pallas: str = "auto"        # fused mask-fill kernel: auto|on|off|interpret
+    use_pallas: str = "auto"        # Pallas kernel tier (fused mask fill +
+                                    # the engines' stem delta-conv and
+                                    # masked-KV attention kernels):
+                                    # auto|on|off|interpret. Meshed
+                                    # certifiers pin the engine kernels off
+                                    # (GSPMD path); mask fill keeps its own
+                                    # shard_map kernel.
     prune: str = "exact"            # double-masking work scheduling:
                                     #  "off"       — the exhaustive 666-mask
                                     #    sweep in one program (parity oracle)
@@ -125,12 +131,13 @@ class DefenseConfig:
     incremental: str = "auto"       # mask-aware incremental masked
                                     # forwards on the pruned certify path:
                                     #  "auto" (default) — per family:
-                                    #    "token-exact" for ViT victims
+                                    #    "token-exact" for ViT victims,
+                                    #    "mixer-exact" for ResMLP victims
                                     #    (verdict contract preserved),
                                     #    "stem" for conv victims (exact by
                                     #    construction), "off" where no
-                                    #    engine exists (ResMLP, stub
-                                    #    apply_fns, n_patch!=1 certifiers,
+                                    #    engine exists (stub apply_fns,
+                                    #    n_patch!=1 certifiers,
                                     #    prune="off"). Meshed certifiers
                                     #    run it too, on the same
                                     #    shard-local schedule.
@@ -148,12 +155,18 @@ class DefenseConfig:
                                     #    program, so VERDICTS stay
                                     #    bit-identical whenever drift stays
                                     #    below the margin.
+                                    #  "mixer"/"mixer-exact" — the ResMLP
+                                    #    twins of "token"/"token-exact":
+                                    #    dirty-row tracking with the token
+                                    #    mix's skinny [dirty, dirty] delta
+                                    #    slice (models/resmlp.py), same
+                                    #    margin/escalation contract.
                                     #  "stem" — conv families: the exact
                                     #    masked-stem fold for the 36-mask
                                     #    first round (ops/stem_fold.py).
                                     #  "off" — PR 5 behavior: full masked
                                     #    forwards for every scheduled entry.
-    incremental_margin: float = 0.5 # "token-exact" escalation threshold:
+    incremental_margin: float = 0.5 # "-exact" escalation threshold:
                                     # top-2 logit gap below which an
                                     # incremental entry is distrusted and
                                     # its image re-certified exhaustively.

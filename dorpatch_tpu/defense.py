@@ -66,6 +66,15 @@ per-image clean-activation cache:
   `incremental_margin` of the argmax boundary through the exhaustive
   program — verdicts then stay bit-identical whenever the drift stays
   below that documented tolerance.
+- ResMLP families ("mixer", `models.resmlp.MixerPrunedResMLP`): the only
+  cross-token operator — Affine then the token-mixing Linear — is exactly
+  linear, so each masked entry tracks only its dirty token rows and
+  propagates their delta through a skinny `[dirty, dirty]` slice of the
+  `[T, T]` mixing matmul against cached clean block inputs/mix outputs,
+  then runs the channel MLP dense on the dirty rows alone; the mean-pool
+  head is linear too, so clean logits plus a rank-S pooled delta finish
+  the entry. Same contract as "token": exact per block given its inputs,
+  frozen clean rows drift, margins returned, "mixer-exact" escalates.
 - Conv families ("stem", `ops.stem_fold.StemFoldEngine`): the bias-free
   stem conv is linear, so the 36-mask first round folds `apply_masks`
   into per-mask delta convs over static windows scattered into one shared
@@ -112,11 +121,14 @@ PRUNE_MODES = ("off", "exact", "consensus")
 
 #: Legal values of `DefenseConfig.incremental`: mask-aware incremental
 #: masked forwards riding the pruned dispatch path. "auto" resolves per
-#: victim family ("token" for ViT engines, "stem" for conv engines, "off"
-#: where no engine exists); "token-exact" adds margin-gated escalation to
-#: the exhaustive program so verdicts stay bit-identical whenever the
-#: token path's logit drift stays below `DefenseConfig.incremental_margin`.
-INCREMENTAL_MODES = ("auto", "token", "token-exact", "stem", "off")
+#: victim family ("token" for ViT engines, "mixer" for ResMLP engines,
+#: "stem" for conv engines, "off" where no engine exists); the "-exact"
+#: variants of the margin families (token, mixer) add margin-gated
+#: escalation to the exhaustive program so verdicts stay bit-identical
+#: whenever the incremental path's logit drift stays below
+#: `DefenseConfig.incremental_margin`.
+INCREMENTAL_MODES = ("auto", "token", "token-exact", "mixer",
+                     "mixer-exact", "stem", "off")
 
 #: Sentinel for double-masked table entries the pruned path never evaluated
 #: (provably unread by the verdict); `preds_2` slots hold labels >= 0 only
@@ -427,11 +439,14 @@ class _PrunedPending:
             pc.num_first, pc.num_second, self.mode)
         self.pair_idx = np.nonzero(need_pairs)[0]
 
-        token = self.incr in ("token", "token-exact")
-        pairs_prog = pc._pairs_incr if token else pc._pairs
+        # the margin families (token, mixer) share the engine program
+        # shapes: pairs/rows return (preds, margins) and rows take
+        # combined-table index rows
+        rowsets = self.incr.split("-")[0] in ("token", "mixer")
+        pairs_prog = pc._pairs_incr if rowsets else pc._pairs
         grid_full = np.asarray(pc._grid_full)
         if pc.mesh is not None:
-            return self._schedule_mesh(pairs_prog, grid_full, token)
+            return self._schedule_mesh(pairs_prog, grid_full, rowsets)
 
         # Both worklists dispatch through a greedy bucket decomposition
         # (`data.bucket_plan`: full buckets largest-first, one padded tail)
@@ -463,8 +478,8 @@ class _PrunedPending:
             img_idx = [b for b, _ in chunk] + [chunk[-1][0]] * (wb - w)
             mask_idx = [i for _, i in chunk] + [chunk[-1][1]] * (wb - w)
             xg = jnp.take(self.imgs, jnp.asarray(img_idx), axis=0)
-            if token:
-                # the token rows program takes each entry's combined-table
+            if rowsets:
+                # the engine rows program takes each entry's combined-table
                 # index row (the grid gather happens host-side, where the
                 # first-mask ids live anyway)
                 t = pc._rows_incr(self.params, xg,
@@ -477,7 +492,7 @@ class _PrunedPending:
                 (t, [(pos, b, i) for pos, (b, i) in enumerate(chunk)]))
         return self
 
-    def _schedule_mesh(self, pairs_prog, grid_full, token: bool):
+    def _schedule_mesh(self, pairs_prog, grid_full, rowsets: bool):
         """Shard-local phase-2 dispatch (the meshed leg of the two-phase
         schedule; see the module docstring's mesh paragraph).
 
@@ -548,7 +563,7 @@ class _PrunedPending:
                 jnp.take(self.imgs, jnp.asarray(img_idx.reshape(-1)),
                          axis=0))
             flat_masks = mask_idx.reshape(-1)
-            if token:
+            if rowsets:
                 t = pc._rows_incr(self.params, xg,
                                   jnp.asarray(grid_full[flat_masks],
                                               dtype=jnp.int32))
@@ -560,7 +575,8 @@ class _PrunedPending:
 
     def finalize(self) -> List[PatchCleanserRecord]:
         """Materialize phase-2 outputs and assemble records (host work;
-        syncs the phase-2 prediction tables). Under "token-exact" this is
+        syncs the phase-2 prediction tables). Under the "-exact" margin
+        modes ("token-exact", "mixer-exact") this is
         also where escalation happens: any image whose evaluated
         incremental entries include a top-2 logit margin below
         `DefenseConfig.incremental_margin` is re-certified through the
@@ -570,8 +586,8 @@ class _PrunedPending:
         pc = self.pc
         m, p = pc.num_first, pc.num_second
         p1, majority, unanimous = self.p1, self.majority, self.unanimous
-        token = self.incr in ("token", "token-exact")
-        if token and self.m1 is None:
+        margins_on = self.incr.split("-")[0] in ("token", "mixer")
+        if margins_on and self.m1 is None:
             self.m1 = np.asarray(self.t1_margins)[:self.n]
 
         def split(t):
@@ -614,7 +630,7 @@ class _PrunedPending:
         min_margin = np.full((self.n,), np.inf)
         for b in range(self.n):
             mj = int(majority[b])
-            if token:
+            if margins_on:
                 min_margin[b] = self.m1[b].min()
             if unanimous[b]:
                 if b in pair_tables:   # "exact": the certificate audit
@@ -671,12 +687,12 @@ class _PrunedPending:
         # per-image minimum top-2 logit margin over the evaluated
         # incremental entries; +inf without margins
         self.min_margin = min_margin
-        if self.incr == "token-exact":
+        if self.incr.endswith("-exact"):
             records = self._escalate(records, min_margin)
         return records
 
     def _escalate(self, records, min_margin) -> List[PatchCleanserRecord]:
-        """token-exact: re-run every image whose evaluated incremental
+        """token/mixer-exact: re-run every image whose evaluated incremental
         entries came within `incremental_margin` of the argmax boundary
         through the exhaustive program (bucketed, one designed extra
         dispatch); their records become exactly the oracle's, paying the
@@ -934,30 +950,36 @@ class PatchCleanser:
             f"defense.rows{tag}.r{r}", recompile_budget=row_rb)
 
         # forward-equivalent weights per combined-table mask (full-forward
-        # units): all-ones without an engine; the token engine's family
-        # overwrites them with (dirty tokens + 1) / (T + 1)
+        # units): all-ones without an engine; the margin engines' families
+        # (token, mixer) overwrite them with their dirty-token fractions
         self._fe_combined = np.ones((m + self._num_doubles,), np.float64)
         self._incr_family = None
         self._phase1_incr = self._pairs_incr = self._rows_incr = None
         if (self.incremental_engine is not None
                 and self.config.incremental != "off"):
+            # the engines' Pallas kernel tiers are single-chip (meshed
+            # programs go through GSPMD partitioning the raw pallas_call
+            # would break), so a meshed certifier pins the gate off and
+            # keeps the pure-XLA engine path — parity is trivial there
             fam = self.incremental_engine.build_family(
                 np.asarray(self._rects), m, self.config.chunk_size,
-                self.config.mask_fill)
+                self.config.mask_fill,
+                use_pallas=("off" if self.mesh is not None
+                            else self.config.use_pallas))
             self._incr_family = fam
             kind = self.incremental_engine.kind
             self._phase1_incr = observe.timed_first_call(
                 jax.jit(fam.phase1, out_shardings=osh),
                 f"defense.phase1.{kind}{tag}.r{r}", recompile_budget=rb)
-            if kind == "token":
+            if kind in ("token", "mixer"):
                 self._fe_combined = np.asarray(fam.fe, np.float64)
                 self._pairs_incr = observe.timed_first_call(
                     jax.jit(fam.pairs, out_shardings=osh),
-                    f"defense.pairs.token{tag}.r{r}",
+                    f"defense.pairs.{kind}{tag}.r{r}",
                     recompile_budget=pair_rb)
                 self._rows_incr = observe.timed_first_call(
                     jax.jit(fam.rows, out_shardings=osh),
-                    f"defense.rows.token{tag}.r{r}",
+                    f"defense.rows.{kind}{tag}.r{r}",
                     recompile_budget=row_rb)
         # per-first-mask second-round row cost (all M entries of the row,
         # idempotence diagonal included — matching the row programs, which
@@ -1027,11 +1049,11 @@ class PatchCleanser:
                              prune: Optional[str] = None) -> str:
         """The effective incremental mode: explicit arg > config; "auto"
         resolves to the attached engine's kind. Always "off" without an
-        engine (stub victims, ResMLP), without built incremental programs
+        engine (stub victims), without built incremental programs
         (config.incremental="off" at construction), or when the pruned
         dispatch path itself is off (n_patch!=1, prune="off") —
         incremental forwards ride the two-phase schedule, including its
-        meshed shard-local form. An explicit token/stem request that
+        meshed shard-local form. An explicit token/mixer/stem request that
         contradicts the engine family is a config error, not a silent
         fallback."""
         mode = (self.config.incremental if incremental is None
@@ -1046,11 +1068,12 @@ class PatchCleanser:
         kind = self.incremental_engine.kind
         if mode == "auto":
             # the default keeps the PR 5 verdict contract: conv families
-            # are exact by construction ("stem"); ViT families get the
-            # margin-gated escalation ("token-exact"), whose extra cost is
-            # confined to images near the argmax boundary. Plain "token"
-            # (tolerance-contracted verdicts, no escalation) is opt-in.
-            return "token-exact" if kind == "token" else kind
+            # are exact by construction ("stem"); the margin families
+            # (ViT "token", ResMLP "mixer") get the margin-gated
+            # escalation ("-exact"), whose extra cost is confined to
+            # images near the argmax boundary. The plain modes
+            # (tolerance-contracted verdicts, no escalation) are opt-in.
+            return f"{kind}-exact" if kind in ("token", "mixer") else kind
         if mode != "off" and not mode.startswith(kind):
             raise ValueError(
                 f"incremental={mode!r} but this victim family's engine "
@@ -1064,17 +1087,18 @@ class PatchCleanser:
         registry derive from. `input_kind`: "imgs" (params, [B,H,W,C]),
         "rows" (params, gathered [W,H,W,C], [W] first-mask ids),
         "rows_sets" (params, gathered [W,H,W,C], [W,M] combined-table
-        index rows — the token rows program)."""
+        index rows — the token/mixer rows programs)."""
         r = self.spec.patch_ratio
         tag = getattr(self, "_prog_tag", "")
         mode = self.resolved_incremental(incremental)
-        if mode in ("token", "token-exact"):
+        kind = mode.split("-")[0]
+        if kind in ("token", "mixer"):
             return [
-                (f"defense.phase1.token{tag}.r{r}", self._phase1_incr,
+                (f"defense.phase1.{kind}{tag}.r{r}", self._phase1_incr,
                  "imgs"),
-                (f"defense.pairs.token{tag}.r{r}", self._pairs_incr,
+                (f"defense.pairs.{kind}{tag}.r{r}", self._pairs_incr,
                  "imgs"),
-                (f"defense.rows.token{tag}.r{r}", self._rows_incr,
+                (f"defense.rows.{kind}{tag}.r{r}", self._rows_incr,
                  "rows_sets"),
             ]
         if mode == "stem":
@@ -1123,7 +1147,8 @@ class PatchCleanser:
                     num_classes: Optional[int] = None) -> None:
         """Compile every program the resolved pruned(+incremental) path can
         dispatch at run time: phase 1 per image bucket, the pair audit and
-        row program per worklist bucket — and, under "token-exact", the
+        row program per worklist bucket — and, under the "-exact" margin
+        modes, the
         exhaustive escalation program (pass `num_classes`; it is a static
         argument of `_predict`). The serving warmup calls this so live
         traffic provably never retraces regardless of which verdict classes
@@ -1143,9 +1168,9 @@ class PatchCleanser:
         meshed = self.mesh is not None
         place = self._mesh_place if meshed else (lambda x: x)
         S = self._mesh_data if meshed else 1
-        if mode == "token-exact" and num_classes is None:
+        if mode.endswith("-exact") and num_classes is None:
             raise ValueError(
-                "warm_pruned needs num_classes under token-exact "
+                f"warm_pruned needs num_classes under {mode} "
                 "(the escalation program's static argument)")
 
         def run(prog, *args):
@@ -1160,7 +1185,7 @@ class PatchCleanser:
             run(phase1, params, imgs)
             if not meshed:
                 run(pairs, params, imgs)
-                if mode == "token-exact":
+                if mode.endswith("-exact"):
                     run(self._predict, params, imgs, int(num_classes))
         m = self.num_first
         for w in self.row_bucket_sizes:
@@ -1175,16 +1200,16 @@ class PatchCleanser:
                 run(rows, params, imgs_g, jnp.zeros((wave,), jnp.int32))
             if meshed:
                 run(pairs, params, imgs_g)
-                if mode == "token-exact":
+                if mode.endswith("-exact"):
                     run(self._predict, params, full(w), int(num_classes))
 
     def pruned_trace_counts(self) -> dict:
         """Compiled-trace count per active pruned-path program (the serving
         layer's zero-recompile bookkeeping); includes the escalation
-        program under "token-exact"."""
+        program under the "-exact" margin modes."""
         out = {name: int(fn._cache_size())
                for name, fn, _ in self.pruned_programs()}
-        if self.resolved_incremental() == "token-exact":
+        if self.resolved_incremental().endswith("-exact"):
             out[f"defense.predict.r{self.spec.patch_ratio}"] = \
                 int(self._predict._cache_size())
         return out

@@ -32,7 +32,8 @@ class Victim(NamedTuple):
     `apply` expects NHWC float images in [0,1] (normalization folded in) and
     is safe to jit/vmap/grad-through. `incremental` is the family's
     mask-aware incremental-inference engine (`models.vit.TokenPrunedViT`
-    for the ViT families, `ops.stem_fold.StemFoldEngine` for the conv
+    for the ViT families, `models.resmlp.MixerPrunedResMLP` for the
+    ResMLP families, `ops.stem_fold.StemFoldEngine` for the conv
     families, None where no engine exists) — `defense.build_defenses`
     consumes it for `DefenseConfig.incremental`.
     """
@@ -52,12 +53,14 @@ def resolve_arch(arch: str) -> str:
         return "cifar_resnet18"
     if arch == "cifar_vit":
         return "cifar_vit"
+    if arch == "cifar_resmlp":
+        return "cifar_resmlp"
     for tm in TIMM_MODELS:
         if arch in tm:
             return tm
     raise ValueError(
         f"unknown architecture {arch!r}; supported: "
-        f"{TIMM_MODELS + ('cifar_resnet18', 'cifar_vit')}")
+        f"{TIMM_MODELS + ('cifar_resnet18', 'cifar_vit', 'cifar_resmlp')}")
 
 
 def checkpoint_path(model_dir: str, dataset: str, timm_name: str) -> str:
@@ -93,6 +96,10 @@ def _build_flax(timm_name: str, num_classes: int, gn_impl: str = "auto"):
         from dorpatch_tpu.models.vit import vit_cifar
 
         return vit_cifar(num_classes)
+    if timm_name == "cifar_resmlp":
+        from dorpatch_tpu.models.resmlp import resmlp_cifar
+
+        return resmlp_cifar(num_classes)
     raise NotImplementedError(timm_name)
 
 
@@ -132,8 +139,9 @@ def incremental_engine(timm_name: str, model, img_size: int):
 
     ViT families get the token-pruned engine (clean KV cache + dirty-token
     recompute, `models/vit.py`); conv families get the exact masked-stem
-    fold (`ops/stem_fold.py`). ResMLP has neither (its token-mixing MLP
-    makes every token dirty after one block) and runs the standard path.
+    fold (`ops/stem_fold.py`); ResMLP gets the mixer-pruned engine
+    (`models/resmlp.py`: cached block inputs + skinny dirty-row slice of
+    the token-mixing matmul, margin-gated like the ViT engine).
     """
     if timm_name in ("vit_base_patch16_224", "cifar_vit"):
         from dorpatch_tpu.models.vit import TokenPrunedViT
@@ -141,6 +149,12 @@ def incremental_engine(timm_name: str, model, img_size: int):
         if img_size % model.patch_size:
             return None  # non-grid-aligned input: no token geometry
         return TokenPrunedViT(model, img_size, normalize=_normalize)
+    if timm_name in ("resmlp_24_distilled_224", "cifar_resmlp"):
+        from dorpatch_tpu.models.resmlp import MixerPrunedResMLP
+
+        if img_size % model.patch_size:
+            return None  # non-grid-aligned input: no token geometry
+        return MixerPrunedResMLP(model, img_size, normalize=_normalize)
     if timm_name == "cifar_resnet18":
         from dorpatch_tpu.ops.stem_fold import StemFoldEngine
 

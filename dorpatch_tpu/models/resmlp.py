@@ -1,16 +1,48 @@
-"""Flax ResMLP-24, NHWC, matching timm's `resmlp_24_distilled_224`.
+"""Flax ResMLP-24, NHWC, matching timm's `resmlp_24_distilled_224`, plus
+the mixer-pruned incremental masked-inference engine.
 
 Third victim family of the reference (`/root/reference/utils.py:51-52`).
 timm contract (mlp_mixer.py ResBlock): 16x16 conv patch embed -> 196 tokens
 of dim 384; 24 residual blocks of [Affine norm -> token-mixing Linear(196,196)
 on the transposed sequence -> layerscale] and [Affine norm -> channel MLP
 (ratio 4, exact GELU) -> layerscale]; final Affine; mean pool; linear head.
+
+Incremental masked inference (`MixerPrunedResMLP`, ROADMAP item 3c): a
+PatchCleanser occlusion mask touches a few patch tokens, and ResMLP's only
+cross-token operator — the Affine -> token-mixing Linear path — is exactly
+linear in its input. So per masked entry the engine tracks only the S
+mask-touched (dirty) token rows: the clean per-block inputs, token-mix
+outputs, and final pre-pool activations are cached once per image
+(`ResMLP.__call__` mode="cache"), and each block propagates the dirty
+rows' delta through the token mix as a skinny `[S, S]` slice of the
+`[T, T]` mixing matmul (`K[idx][:, idx]` — the dirty rows' contribution to
+the dirty outputs; contributions to clean rows are dropped, see below)
+before the per-token channel MLP runs dense on the S dirty rows alone.
+The mean-pool head is linear too, so the final logits are the cached
+clean logits plus a rank-S pooled delta through the head matrix.
+
+Exactness contract (the ViT token engine's, `models/vit.py`): dirty-row
+updates are exact given their block inputs — the token-mix delta `z_dirty
+= z_clean[idx] + K[idx, idx]^T (alpha1 * (d - x_clean[idx]))` is the
+masked forward's exact mix value when all non-dirty tokens hold their
+clean activations — but untouched tokens keep clean activations at every
+depth, while the true masked forward would drift them through the mixing
+matrix from block 1 on. Programs therefore return top-2 logit margins and
+`defense.py`'s "mixer-exact" mode re-runs near-boundary images through
+the exhaustive program, keeping verdicts bit-identical whenever the drift
+stays below `DefenseConfig.incremental_margin`.
 """
 
 from __future__ import annotations
 
+from typing import Callable, NamedTuple, Optional
+
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+import numpy as np
+
+from dorpatch_tpu import masks as masks_lib
 
 
 class Affine(nn.Module):
@@ -31,17 +63,19 @@ class ResMLPBlock(nn.Module):
     mlp_ratio: int = 4
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, return_mix: bool = False):
         ls1 = self.param("ls1", nn.initializers.ones, (self.dim,), jnp.float32)
         ls2 = self.param("ls2", nn.initializers.ones, (self.dim,), jnp.float32)
         y = Affine(self.dim, name="norm1")(x)
         y = nn.Dense(self.seq_len, name="linear_tokens")(y.transpose(0, 2, 1))
-        x = x + ls1 * y.transpose(0, 2, 1)
+        z = y.transpose(0, 2, 1)  # [B, T, D] token-mix output (pre-layerscale)
+        x = x + ls1 * z
         y = Affine(self.dim, name="norm2")(x)
         y = nn.Dense(self.dim * self.mlp_ratio, name="mlp_fc1")(y)
         y = nn.gelu(y, approximate=False)
         y = nn.Dense(self.dim, name="mlp_fc2")(y)
-        return x + ls2 * y
+        x = x + ls2 * y
+        return (x, z) if return_mix else x
 
 
 class ResMLP(nn.Module):
@@ -52,7 +86,16 @@ class ResMLP(nn.Module):
     img_size: int = 224
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, mode: str = "full"):
+        """mode="full": logits. mode="cache": `(block_inputs, mix_outputs,
+        final)` — the per-block INPUT activations `depth x [B, T, D]`, the
+        per-block token-mix outputs `depth x [B, T, D]` (post-transpose,
+        bias included, pre-layerscale — collected where the block computes
+        them anyway, so cache mode costs one ordinary forward), and the
+        final pre-Affine activations `[B, T, D]`. The mixer incremental
+        engine's clean cache."""
+        if mode not in ("full", "cache"):
+            raise ValueError(f"mode={mode!r} (use 'full' or 'cache')")
         B = x.shape[0]
         x = nn.Conv(
             self.dim,
@@ -63,8 +106,17 @@ class ResMLP(nn.Module):
         )(x)
         x = x.reshape(B, -1, self.dim)
         seq_len = x.shape[1]
+        xs, zs = [], []
         for i in range(self.depth):
-            x = ResMLPBlock(self.dim, seq_len, name=f"block{i}")(x)
+            block = ResMLPBlock(self.dim, seq_len, name=f"block{i}")
+            if mode == "cache":
+                xs.append(x)
+                x, z = block(x, return_mix=True)
+                zs.append(z)
+            else:
+                x = block(x)
+        if mode == "cache":
+            return tuple(xs), tuple(zs), x
         x = Affine(self.dim, name="norm")(x)
         x = x.mean(axis=1)
         return nn.Dense(self.num_classes, name="head")(x)
@@ -72,3 +124,294 @@ class ResMLP(nn.Module):
 
 def resmlp_24(num_classes: int) -> ResMLP:
     return ResMLP(num_classes=num_classes)
+
+
+# CIFAR-scale ResMLP (the `vit_cifar` idiom): 4px patches on 32px images
+# -> 64 tokens of dim 128, depth 6 — the third victim family at sweep /
+# audit scale, small enough to trace and certify on CPU CI.
+CIFAR_RESMLP = dict(patch_size=4, dim=128, depth=6, img_size=32)
+
+
+def resmlp_cifar(num_classes: int) -> ResMLP:
+    return ResMLP(num_classes=num_classes, **CIFAR_RESMLP)
+
+
+# ------------------------------------------- mixer-pruned incremental engine
+
+
+def _default_normalize(x):
+    """Fallback for directly-constructed engines; the factory
+    (`models.registry.incremental_engine`) always passes its own
+    `registry._normalize`, the single production definition."""
+    return (x - 0.5) / 0.5
+
+
+class _MixerTables(NamedTuple):
+    """Static per-mask-family lookup tables, device-resident (the
+    `models.vit._TokenTables` idiom; no cls slot — ResMLP mean-pools)."""
+
+    idx: jax.Array   # [N, S] int32 dirty token ids (pad repeats the first)
+    keep: jax.Array  # [N, S, p, p, 1] f32 pixel keep-mask per dirty slot
+    w: jax.Array     # [N, S] f32 slot weight: 1 real, 0 duplicate padding
+    #                  (multiplicative — the mixer SUMS dirty contributions,
+    #                  so duplicate slots must contribute zero, where the
+    #                  ViT engine's additive -1e9 softmax bias lives)
+    fe: np.ndarray   # [N] float64 forward equivalents: dirty tokens / T
+
+
+def _build_mixer_tables(rects: np.ndarray, img_size: int,
+                        patch: int) -> _MixerTables:
+    """Token sets + per-token pixel keep masks for one rectangle table.
+    Slots beyond a mask's real coverage repeat slot 0 (same token, same
+    keep mask) and carry weight 0, so they compute the identical dirty
+    value and contribute nothing to any linear sum."""
+    rects = np.asarray(rects, np.int64)
+    if rects.ndim == 2:
+        rects = rects[:, None, :]
+    grid = img_size // patch
+    cov = masks_lib.rect_token_coverage(rects, img_size, patch)  # [N, T]
+    n, t_total = cov.shape
+    s_max = int(cov.sum(axis=1).max()) if n else 1
+    idx = np.zeros((n, s_max), np.int32)
+    keep = np.ones((n, s_max, patch, patch, 1), np.float32)
+    w = np.zeros((n, s_max), np.float32)
+    for i in range(n):
+        toks = np.nonzero(cov[i])[0]
+        padded = np.concatenate([toks, np.full(s_max - len(toks), toks[0])])
+        idx[i] = padded
+        w[i, :len(toks)] = 1.0
+        for s, tok in enumerate(padded):
+            pr, pc = divmod(int(tok), grid)
+            r_off, c_off = pr * patch, pc * patch
+            for r0, r1, c0, c1 in rects[i]:
+                rr0, rr1 = max(r0 - r_off, 0), min(r1 - r_off, patch)
+                cc0, cc1 = max(c0 - c_off, 0), min(c1 - c_off, patch)
+                if rr0 < rr1 and cc0 < cc1:
+                    keep[i, s, rr0:rr1, cc0:cc1, 0] = 0.0
+    fe = cov.sum(axis=1) / float(t_total)
+    return _MixerTables(jnp.asarray(idx), jnp.asarray(keep),
+                        jnp.asarray(w), fe)
+
+
+class ResMLPMixerFamily:
+    """One mask family's incremental programs (the `models.vit.
+    TokenViTFamily` contract): `phase1`/`pairs`/`rows`, each returning
+    `(preds int32, margins f32)`, with forward-equivalent weights in
+    `.fe` and the per-image clean-cache cost in `.cache_fe`."""
+
+    def __init__(self, engine: "MixerPrunedResMLP", rects: np.ndarray,
+                 num_singles: int, chunk_size: int, fill: float,
+                 use_pallas: str = "auto"):
+        self.engine = engine
+        self.num_singles = int(num_singles)
+        self.chunk_size = max(1, int(chunk_size))
+        self.fill = float(fill)
+        # accepted for build_family signature parity with the kernel-tier
+        # engines; the mixer's skinny [S, S] mix slice + dense dirty-row
+        # MLP is already plain matmuls XLA fuses — no Pallas tier (yet)
+        self.use_pallas = use_pallas
+        img, patch = engine.img_size, engine.patch
+        self.first = _build_mixer_tables(rects[:num_singles], img, patch)
+        self.pair_tables = _build_mixer_tables(rects[num_singles:], img,
+                                               patch)
+        self.combined = _build_mixer_tables(rects, img, patch)
+        self.fe = self.combined.fe
+        self.fe_first = float(self.fe[:num_singles].sum())
+        self.fe_pairs = float(self.fe[num_singles:].sum())
+        # per-invocation clean-cache cost in full-forward units: "cache"
+        # mode runs every block (collecting the mix outputs where the
+        # block computes them anyway), i.e. one forward minus the head
+        self.cache_fe = 1.0
+
+    def phase1(self, params, imgs):
+        return self.engine._table(params, imgs, self.first,
+                                  self.fill, self.chunk_size)
+
+    def pairs(self, params, imgs):
+        return self.engine._table(params, imgs, self.pair_tables,
+                                  self.fill, self.chunk_size)
+
+    def rows(self, params, imgs_g, sets_idx):
+        return self.engine._rows(params, imgs_g, sets_idx, self.combined,
+                                 self.fill, self.chunk_size)
+
+
+class MixerPrunedResMLP:
+    """Mixer-pruned incremental masked inference for one ResMLP victim.
+
+    Built by `models.registry.incremental_engine` for the ResMLP family
+    and handed to `defense.build_defenses(..., incremental=...)`;
+    `build_family` is called once per certifier (mask radius) with its
+    combined rectangle table."""
+
+    kind = "mixer"
+
+    def __init__(self, module: ResMLP, img_size: int,
+                 normalize: Optional[Callable[[jax.Array], jax.Array]] = None):
+        if img_size % module.patch_size:
+            raise ValueError(
+                f"img_size={img_size} not divisible by patch "
+                f"{module.patch_size}")
+        self.module = module
+        self.img_size = int(img_size)
+        self.patch = int(module.patch_size)
+        self.grid = self.img_size // self.patch
+        self.tokens = self.grid * self.grid
+        self.normalize = normalize or _default_normalize
+
+    def build_family(self, rects: np.ndarray, num_singles: int,
+                     chunk_size: int, fill: float,
+                     use_pallas: str = "auto") -> ResMLPMixerFamily:
+        return ResMLPMixerFamily(self, rects, num_singles, chunk_size,
+                                 fill, use_pallas=use_pallas)
+
+    # ------------------------------------------------------------ internals
+
+    def _patches(self, imgs: jax.Array) -> jax.Array:
+        """[B, H, W, C] -> [B, T, p, p, C] row-major patches (the conv
+        patch embed's token order)."""
+        b, h, w, c = imgs.shape
+        p, g = self.patch, self.grid
+        x = imgs.reshape(b, g, p, g, p, c)
+        return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(b, g * g, p, p, c)
+
+    def _embed(self, params, patches_g, keep, fill):
+        """Dirty-token embeddings: occlude the gathered raw patches with
+        the static keep masks, normalize, apply the patch-embed conv (a
+        p-stride p-kernel conv == one einsum per token). No cls token, no
+        position embedding — ResMLP has neither."""
+        p = params["params"]
+        masked = patches_g * keep + fill * (1.0 - keep)
+        xn = self.normalize(masked)
+        return jnp.einsum("...hwc,hwcd->...d", xn,
+                          p["patch_embed"]["kernel"]) \
+            + p["patch_embed"]["bias"]
+
+    def _clean(self, params, imgs):
+        """The per-image clean cache: block inputs, token-mix outputs,
+        final pre-Affine activations — plus the clean logits (the Affine
+        + mean pool + head are linear, so per entry only a pooled dirty
+        delta rides through the head)."""
+        xs, zs, xf = self.module.apply(params, self.normalize(imgs),
+                                       "cache")
+        p = params["params"]
+        pooled = (p["norm"]["alpha"] * xf + p["norm"]["beta"]).mean(axis=1)
+        logits0 = pooled @ p["head"]["kernel"] + p["head"]["bias"]
+        return xs, zs, xf, logits0
+
+    def _forward(self, params, d, xs, zs, xf, logits0, idxc, wc):
+        """Dirty tokens `d [B, C, S, D]` (C masks per image) through every
+        block against the per-image clean cache. Per block: the token-mix
+        value at each dirty row is the cached clean mix `zs[l]` gathered
+        at the dirty ids plus the skinny delta `K[idx][:, idx]^T (alpha1 *
+        (d - x_clean[idx]))` — exactly the masked mix under the
+        frozen-clean-rows approximation, because the mix is linear and
+        only the dirty rows' inputs differ. Then the channel MLP runs
+        dense on the S dirty rows. Finally the clean logits get the
+        pooled dirty delta through the head. `wc [B, C, S]` zeroes
+        duplicate padding slots out of every sum."""
+        p = params["params"]
+        t = self.tokens
+        gather = jax.vmap(lambda a, i: a[i])   # [B,T,D], [B,C,S] -> [B,C,S,D]
+        for layer in range(self.module.depth):
+            bp = p[f"block{layer}"]
+            k_mix = bp["linear_tokens"]["kernel"]        # [T, T]
+            xg = gather(xs[layer], idxc)
+            zg = gather(zs[layer], idxc)
+            a1 = bp["norm1"]["alpha"] * (d - xg) * wc[..., None]
+            # [B, C, S, S] dirty->dirty slice of the [T, T] mixing matmul
+            kss = k_mix[idxc[..., :, None], idxc[..., None, :]]
+            dz = jnp.einsum("bcsd,bcst->bctd", a1, kss)
+            d = d + bp["ls1"] * (zg + dz)
+            y = bp["norm2"]["alpha"] * d + bp["norm2"]["beta"]
+            h = nn.gelu(y @ bp["mlp_fc1"]["kernel"]
+                        + bp["mlp_fc1"]["bias"], approximate=False)
+            d = d + bp["ls2"] * (h @ bp["mlp_fc2"]["kernel"]
+                                 + bp["mlp_fc2"]["bias"])
+        xgf = gather(xf, idxc)
+        pooled_d = ((d - xgf) * wc[..., None]).sum(axis=2) / float(t)
+        return logits0[:, None] \
+            + (p["norm"]["alpha"] * pooled_d) @ p["head"]["kernel"]
+
+    @staticmethod
+    def _preds_margins(logits):
+        from dorpatch_tpu.utils import preds_margins
+
+        return preds_margins(logits)
+
+    def _chunk(self, params, patches, clean, idxc, keepc, wc, fill):
+        """One mask chunk: [B images, c masks] dirty-token batch against
+        the per-image clean cache (shared across the mask axis). Tables
+        are PER-IMAGE (`[B, c, ...]`), the `models.vit` chunk contract."""
+        pg = jax.vmap(lambda pp, ii: pp[ii])(patches, idxc)  # [B,c,S,p,p,C]
+        d = self._embed(params, pg, keepc, fill)             # [B, c, S, D]
+        logits = self._forward(params, d, *clean, idxc, wc)
+        return self._preds_margins(logits)                   # [B, c] each
+
+    def _table(self, params, imgs, tables: _MixerTables, fill, chunk_size):
+        """All N masks of `tables` over the batch -> (preds, margins)
+        `[B, N]`, scanning mask chunks of <= chunk_size. Padding masks
+        repeat entry 0 and are sliced off."""
+        n = int(tables.idx.shape[0])
+        c = min(max(1, int(chunk_size)), n) if n else 1
+        n_chunks = -(-n // c) if n else 0
+        pad = n_chunks * c - n
+
+        def padded(t):
+            return jnp.concatenate(
+                [t, jnp.broadcast_to(t[:1], (pad,) + t.shape[1:])]
+            ).reshape((n_chunks, c) + t.shape[1:])
+
+        idx_p = padded(tables.idx)
+        keep_p = padded(tables.keep)
+        w_p = padded(tables.w)
+        clean = self._clean(params, imgs)
+        patches = self._patches(imgs)
+        b = imgs.shape[0]
+
+        def body(carry, xs_):
+            idxc, keepc, wc = xs_
+
+            def bc(t):  # shared mask chunk -> per-image [B, c, ...]
+                return jnp.broadcast_to(t[None], (b,) + t.shape)
+
+            return carry, self._chunk(params, patches, clean, bc(idxc),
+                                      bc(keepc), bc(wc), fill)
+
+        _, (preds, margins) = jax.lax.scan(body, None, (idx_p, keep_p, w_p))
+        preds = jnp.moveaxis(preds, 0, 1).reshape(b, -1)[:, :n]
+        margins = jnp.moveaxis(margins, 0, 1).reshape(b, -1)[:, :n]
+        return preds, margins
+
+    def _rows(self, params, imgs_g, sets_idx, combined: _MixerTables, fill,
+              chunk_size):
+        """Ragged second-round rows: entry w = (gathered image, [M2] row
+        of combined-table mask indices), chunked exactly like
+        `models.vit.TokenPrunedViT._rows`."""
+        w, m2 = int(sets_idx.shape[0]), int(sets_idx.shape[1])
+        c = max(1, min(m2, int(chunk_size) // max(1, w)))
+        n_chunks = -(-m2 // c)
+        pad = n_chunks * c - m2
+        sets_p = jnp.concatenate(
+            [sets_idx, jnp.broadcast_to(sets_idx[:, :1], (w, pad))], axis=1)
+        idx_all = combined.idx[sets_p]        # [W, M2p, S]
+        keep_all = combined.keep[sets_p]      # [W, M2p, S, p, p, 1]
+        w_all = combined.w[sets_p]            # [W, M2p, S]
+        clean = self._clean(params, imgs_g)
+        patches = self._patches(imgs_g)
+
+        def chunked(t):  # [W, M2p, ...] -> scan xs [nc, W, c, ...]
+            return jnp.moveaxis(
+                t.reshape((w, n_chunks, c) + t.shape[2:]), 1, 0)
+
+        def body(carry, xs_):
+            idxc, keepc, wc = xs_             # [W, c, ...]
+            return carry, self._chunk(params, patches, clean, idxc, keepc,
+                                      wc, fill)
+
+        _, (preds, margins) = jax.lax.scan(
+            body, None, (chunked(idx_all), chunked(keep_all),
+                         chunked(w_all)))
+        preds = jnp.moveaxis(preds, 0, 1).reshape(w, -1)[:, :m2]
+        margins = jnp.moveaxis(margins, 0, 1).reshape(w, -1)[:, :m2]
+        return preds, margins

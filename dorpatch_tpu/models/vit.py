@@ -50,6 +50,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from dorpatch_tpu import masks as masks_lib
+from dorpatch_tpu.ops import _backend
+from dorpatch_tpu.ops import masked_kv_attn
 
 
 class ViTBlock(nn.Module):
@@ -210,11 +212,13 @@ class TokenViTFamily:
     mask are in `.fe`; `fe_first`/`fe_pairs` are the per-image sums."""
 
     def __init__(self, engine: "TokenPrunedViT", rects: np.ndarray,
-                 num_singles: int, chunk_size: int, fill: float):
+                 num_singles: int, chunk_size: int, fill: float,
+                 use_pallas: str = "auto"):
         self.engine = engine
         self.num_singles = int(num_singles)
         self.chunk_size = max(1, int(chunk_size))
         self.fill = float(fill)
+        self.use_pallas = use_pallas
         img, patch = engine.img_size, engine.patch
         self.first = _build_tables(rects[:num_singles], img, patch)
         self.pair_tables = _build_tables(rects[num_singles:], img, patch)
@@ -235,15 +239,18 @@ class TokenViTFamily:
 
     def phase1(self, params, imgs):
         return self.engine._table(params, imgs, self.first,
-                                  self.fill, self.chunk_size)
+                                  self.fill, self.chunk_size,
+                                  self.use_pallas)
 
     def pairs(self, params, imgs):
         return self.engine._table(params, imgs, self.pair_tables,
-                                  self.fill, self.chunk_size)
+                                  self.fill, self.chunk_size,
+                                  self.use_pallas)
 
     def rows(self, params, imgs_g, sets_idx):
         return self.engine._rows(params, imgs_g, sets_idx, self.combined,
-                                 self.fill, self.chunk_size)
+                                 self.fill, self.chunk_size,
+                                 self.use_pallas)
 
 
 class TokenPrunedViT:
@@ -269,8 +276,10 @@ class TokenPrunedViT:
         self.normalize = normalize or _default_normalize
 
     def build_family(self, rects: np.ndarray, num_singles: int,
-                     chunk_size: int, fill: float) -> TokenViTFamily:
-        return TokenViTFamily(self, rects, num_singles, chunk_size, fill)
+                     chunk_size: int, fill: float,
+                     use_pallas: str = "auto") -> TokenViTFamily:
+        return TokenViTFamily(self, rects, num_singles, chunk_size, fill,
+                              use_pallas=use_pallas)
 
     # ------------------------------------------------------------ internals
 
@@ -322,21 +331,27 @@ class TokenPrunedViT:
                       + a["value"]["bias"])
         return tuple(ks), tuple(vs)
 
-    def _forward(self, params, d, kcs, vcs, idx, slot_bias):
+    def _forward(self, params, d, kcs, vcs, idx, slot_bias, attn="off"):
         """Dirty tokens `d [B, C, S, D]` (C masks per image) through every
         block against the per-IMAGE clean KV caches (`kcs`/`vcs`:
         `depth x [B, T+1, H, hd]`). Attention concatenates two key/value
-        groups per query: the shared clean cache — read IN PLACE via a
-        batched einsum, never copied per mask; the stale rows at the dirty
-        positions are excluded with an additive -1e9 bias — and the S
-        dirty rows' freshly projected K/V (duplicate padding slots masked
-        by `slot_bias`). Queries, dirty K/V projections, and the MLP all
+        groups per query: the shared clean cache — read IN PLACE, never
+        copied per mask; the stale rows at the dirty positions are
+        excluded with an additive -1e9 bias — and the S dirty rows'
+        freshly projected K/V (duplicate padding slots masked by
+        `slot_bias`). Queries, dirty K/V projections, and the MLP all
         run on the S dirty rows only, so per-entry cost scales with
         S/(T+1) in both FLOPs and memory traffic. Then the cls readout ->
         logits [B, C, num_classes]. Math mirrors flax
         `nn.MultiHeadDotProductAttention` (scaled q, per-head softmax;
         softmax is order-invariant, so regrouping the sequence cannot
-        change the probabilities beyond summation order)."""
+        change the probabilities beyond summation order).
+
+        `attn` is the RESOLVED kernel gate ("off" | "on" | "interpret"):
+        off composes the attention read from einsums; otherwise the fused
+        `ops.masked_kv_attn` kernel reads the cached K/V blocks in place
+        with both biases folded into the logits on-chip (same math,
+        regrouped reductions — allclose, margin-contracted verdicts)."""
         p = params["params"]
         t1 = kcs[0].shape[1]
         hd = self.module.dim // self.module.num_heads
@@ -345,7 +360,8 @@ class TokenPrunedViT:
         # dirty positions (their cached K/V is stale; the dirty group
         # carries the fresh rows). Mask geometry is layer-independent.
         stale = jnp.any(idx[..., None] == jnp.arange(t1), axis=-2)
-        clean_bias = jnp.where(stale, -1e9, 0.0)[..., None, None, :]
+        stale_bias = jnp.where(stale, -1e9, 0.0)
+        clean_bias = stale_bias[..., None, None, :]
         dirty_bias = slot_bias[..., None, None, :]
         for layer in range(self.module.depth):
             bp = p[f"block{layer}"]
@@ -358,11 +374,18 @@ class TokenPrunedViT:
                 + a["key"]["bias"]
             vd = jnp.einsum("bcsd,dhf->bcshf", ln_d, a["value"]["kernel"]) \
                 + a["value"]["bias"]
-            wc = jnp.einsum("bcshf,bthf->bchst", q, kcs[layer]) + clean_bias
-            wd = jnp.einsum("bcshf,bcthf->bchst", q, kd) + dirty_bias
-            w = jax.nn.softmax(jnp.concatenate([wc, wd], axis=-1), axis=-1)
-            o = jnp.einsum("bchst,bthf->bcshf", w[..., :t1], vcs[layer]) \
-                + jnp.einsum("bchst,bcthf->bcshf", w[..., t1:], vd)
+            if attn == "off":
+                wc = jnp.einsum("bcshf,bthf->bchst", q, kcs[layer]) \
+                    + clean_bias
+                wd = jnp.einsum("bcshf,bcthf->bchst", q, kd) + dirty_bias
+                w = jax.nn.softmax(jnp.concatenate([wc, wd], axis=-1),
+                                   axis=-1)
+                o = jnp.einsum("bchst,bthf->bcshf", w[..., :t1], vcs[layer]) \
+                    + jnp.einsum("bchst,bcthf->bcshf", w[..., t1:], vd)
+            else:
+                o = masked_kv_attn.masked_kv_attention(
+                    q, kd, vd, kcs[layer], vcs[layer], stale_bias,
+                    slot_bias, interpret=(attn == "interpret"))
             d = d + jnp.einsum("bcshf,hfd->bcsd", o, a["out"]["kernel"]) \
                 + a["out"]["bias"]
             ln2 = self._ln(d, bp["norm2"])
@@ -379,7 +402,7 @@ class TokenPrunedViT:
         return preds_margins(logits)
 
     def _chunk(self, params, patches, cls0, kcs, vcs, idxc, keepc, biasc,
-               fill):
+               fill, attn="off"):
         """One mask chunk: [B images, c masks] dirty-token batch against
         the per-image clean KV caches (shared across the mask axis — the
         einsums read them in place). Tables are PER-IMAGE (`[B, c, ...]`):
@@ -393,10 +416,11 @@ class TokenPrunedViT:
         emb = self._embed(params, pg, keepc, idxc[..., 1:], fill)
         cls = jnp.broadcast_to(cls0[:, None], (b, c, 1, dim))
         d = jnp.concatenate([cls, emb], axis=2)                 # [B, c, S, D]
-        logits = self._forward(params, d, kcs, vcs, idxc, biasc)
+        logits = self._forward(params, d, kcs, vcs, idxc, biasc, attn)
         return self._preds_margins(logits)                      # [B, c] each
 
-    def _table(self, params, imgs, tables: _TokenTables, fill, chunk_size):
+    def _table(self, params, imgs, tables: _TokenTables, fill, chunk_size,
+               use_pallas: str = "off"):
         """All N masks of `tables` over the batch -> (preds, margins)
         `[B, N]`, scanning mask chunks of <= chunk_size (the same live-
         memory bound as `defense.masked_predictions`). Padding masks repeat
@@ -418,6 +442,7 @@ class TokenPrunedViT:
         cls0 = cache[0][:, :1]
         patches = self._patches(imgs)
         b = imgs.shape[0]
+        attn = _backend.resolve_use_pallas(use_pallas)
 
         def body(carry, xs):
             idxc, keepc, biasc = xs
@@ -426,7 +451,8 @@ class TokenPrunedViT:
                 return jnp.broadcast_to(t[None], (b,) + t.shape)
 
             return carry, self._chunk(params, patches, cls0, kcs, vcs,
-                                      bc(idxc), bc(keepc), bc(biasc), fill)
+                                      bc(idxc), bc(keepc), bc(biasc), fill,
+                                      attn)
 
         _, (preds, margins) = jax.lax.scan(body, None,
                                            (idx_p, keep_p, bias_p))
@@ -435,7 +461,7 @@ class TokenPrunedViT:
         return preds, margins
 
     def _rows(self, params, imgs_g, sets_idx, combined: _TokenTables, fill,
-              chunk_size):
+              chunk_size, use_pallas: str = "off"):
         """Ragged second-round rows: entry w = (gathered image, [M2] row of
         combined-table mask indices). The second-mask axis is processed in
         chunks of `max(1, chunk_size // W)` so each scan step is a
@@ -456,6 +482,7 @@ class TokenPrunedViT:
         kcs, vcs = self._clean_kv(params, cache)
         cls0 = cache[0][:, :1]
         patches = self._patches(imgs_g)
+        attn = _backend.resolve_use_pallas(use_pallas)
 
         def chunked(t):  # [W, M2p, ...] -> scan xs [nc, W, c, ...]
             return jnp.moveaxis(
@@ -464,7 +491,7 @@ class TokenPrunedViT:
         def body(carry, xs):
             idxc, keepc, biasc = xs           # [W, c, ...]
             return carry, self._chunk(params, patches, cls0, kcs, vcs,
-                                      idxc, keepc, biasc, fill)
+                                      idxc, keepc, biasc, fill, attn)
 
         _, (preds, margins) = jax.lax.scan(
             body, None, (chunked(idx_all), chunked(keep_all),

@@ -569,6 +569,16 @@ def format_report(s: dict) -> str:
                 + ", ".join(f"{k}: {v}" for k, v in sorted(by_rule.items())))
             for f in (bl.get("findings") or [])[:8]:
                 add(f"  {f.get('rule', '?')} {f.get('message', '')[:110]}")
+        rows = bl.get("intensity") or []
+        if rows:
+            # estimated bytes accessed + arithmetic intensity (flops/byte)
+            # per heaviest entry: low AI = bandwidth-bound, the programs
+            # the Pallas kernel tier targets
+            add("  heaviest entries (est bytes, flops/byte):")
+            for r in rows:
+                add(f"    {r.get('name', '?')}: "
+                    f"{r.get('est_bytes', 0) / 1e6:,.1f} MB, "
+                    f"AI {r.get('est_ai', 0):.2f}")
 
     add("-- heartbeats --")
     if not s["heartbeats"]:

@@ -1,4 +1,15 @@
-"""Shared backend detection for the Pallas dispatch heuristics."""
+"""Shared backend detection + `use_pallas` gate resolution.
+
+Every Pallas op in the package (`masked_fill`, `fused_gn`, the stem
+delta-conv and masked-KV attention kernels) dispatches behind the same
+four-valued gate — `"auto" | "on" | "off" | "interpret"` — and the "auto"
+heuristic is identical across them: Mosaic kernels only lower on TPU
+backends, and a raw `pallas_call` is a custom call GSPMD cannot partition,
+so on multi-device platforms "auto" engages Pallas only when the caller
+brings an explicit mesh (the op's `shard_map` path). This module is the
+single implementation of that rule; per-op feasibility checks (VMEM plans,
+mesh divisibility) layer on top via the `divisible` argument.
+"""
 
 from __future__ import annotations
 
@@ -12,3 +23,41 @@ def is_tpu_backend() -> bool:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:
         return False
+
+
+def single_device_tpu() -> bool:
+    """True when a bare (shard_map-free) `pallas_call` is safe AND lowers:
+    a TPU backend with exactly one device, so GSPMD never has to partition
+    the custom call."""
+    return is_tpu_backend() and jax.device_count() == 1
+
+
+def resolve_use_pallas(use_pallas: str = "auto", *, mesh=None,
+                       divisible: bool = True) -> str:
+    """Resolve the shared `use_pallas` gate to `"on" | "off" | "interpret"`.
+
+    - "auto" -> "on" iff the backend is a TPU and either the caller passed
+      a multi-device `mesh` (the op runs its Pallas kernel per shard under
+      `shard_map`) or the platform has a single device (a bare
+      `pallas_call` cannot block sharding propagation there). "off"
+      otherwise — CPU tests and virtual meshes take the XLA reference
+      path.
+    - Any non-"off" request on a multi-device mesh whose shapes the op
+      cannot shard (`divisible=False`, e.g. `masked_fill._mesh_divides`)
+      falls back to "off": the partitionable XLA path beats a replicated
+      custom call.
+    - "on"/"off"/"interpret" pass through (modulo the divisibility
+      fallback); anything else raises.
+    """
+    on_mesh = (mesh is not None
+               and getattr(mesh, "devices", None) is not None
+               and mesh.devices.size > 1)
+    if use_pallas == "auto":
+        single = jax.device_count() == 1
+        use_pallas = ("on" if is_tpu_backend() and (on_mesh or single)
+                      else "off")
+    if use_pallas not in ("on", "off", "interpret"):
+        raise ValueError(f"use_pallas={use_pallas!r}")
+    if use_pallas != "off" and on_mesh and not divisible:
+        use_pallas = "off"
+    return use_pallas

@@ -474,10 +474,10 @@ def auto_pallas(x_shape=None, x_dtype=None) -> bool:
     when feasible forward AND backward plans exist — whole-slab or HW-tiled,
     dtype-aware since bf16 slabs stream at half the f32 rate); the
     GSPMD-partitionable path elsewhere."""
-    from dorpatch_tpu.ops._backend import is_tpu_backend
+    from dorpatch_tpu.ops._backend import single_device_tpu
 
     try:
-        ok = is_tpu_backend() and jax.device_count() == 1
+        ok = single_device_tpu()
     except Exception:
         return False
     if ok and x_shape is not None:

@@ -38,6 +38,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from dorpatch_tpu import masks as masks_lib
+from dorpatch_tpu.ops import _backend
 
 
 def masked_fill_reference(imgs: jax.Array, rects: jax.Array, fill: float) -> jax.Array:
@@ -144,14 +145,6 @@ def _vjp_bwd(fill: float, interpret: bool, rects, g):
 _masked_fill_pallas.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def _auto_use_pallas() -> bool:
-    """Pallas iff the backend is a TPU (the Mosaic kernel does not lower on
-    CPU outside interpreter mode)."""
-    from dorpatch_tpu.ops._backend import is_tpu_backend
-
-    return is_tpu_backend()
-
-
 # --------------------------------------------------------- shard_map wrapper
 
 
@@ -239,17 +232,10 @@ def masked_fill(
     to the partitionable XLA path.
     """
     on_mesh = mesh is not None and mesh.devices.size > 1
-    if use_pallas == "auto":
-        # Pallas on TPU; on a multi-device platform only when the caller
-        # provided the mesh (the shard_map path) — a raw pallas_call under
-        # GSPMD would block sharding propagation and replicate the output.
-        single = jax.device_count() == 1
-        use_pallas = "on" if _auto_use_pallas() and (on_mesh or single) else "off"
-    if use_pallas not in ("on", "off", "interpret"):
-        raise ValueError(f"use_pallas={use_pallas!r}")
-    if use_pallas != "off" and on_mesh and not _mesh_divides(
-            imgs, rects, mesh, data_axis, mask_axis):
-        use_pallas = "off"
+    use_pallas = _backend.resolve_use_pallas(
+        use_pallas, mesh=mesh,
+        divisible=(not on_mesh) or _mesh_divides(imgs, rects, mesh,
+                                                 data_axis, mask_axis))
     if use_pallas == "off":
         return masked_fill_reference(imgs, rects, fill)
     if on_mesh:

@@ -1,0 +1,105 @@
+"""In-place masked-KV attention: the token-pruned engines' fused hot loop.
+
+The token-pruned ViT rows/table programs (`models.vit.TokenPrunedViT`) are
+bandwidth-bound on the clean KV cache: per mask entry, the XLA einsum chain
+(`bcshf,bthf->bchst` logits, concat-softmax, two weighted-value einsums)
+streams the whole `[B, T+1, H, hd]` clean K and V tensors from HBM per
+block with materialized `[B, C, H, S, T]` logit/probability intermediates
+in between — the gap ROADMAP item 3(b) names. This kernel fuses one
+block's whole attention read: for each (image, mask) grid step it loads
+the S fresh-query rows, reads the clean K/V blocks IN PLACE (the clean
+cache blocks index only on the image axis, so with the mask axis
+minor-most they load into VMEM once per image and serve every mask), adds
+the additive staleness/pad biases to the logits inside the kernel, runs
+the numerically-stable two-group softmax, and writes only the `[S, H, hd]`
+attention output — no logit or probability tensor ever exists in HBM.
+
+Exactness contract: identical math to the einsum composition (scaled
+queries, additive -1e9 staleness/duplicate-slot biases, max-subtracted
+softmax over the concatenated clean+dirty key axis), reassociated — the
+two groups' max/sum/weighted-value reductions are computed separately and
+combined, so outputs are allclose at tight f32 tolerance rather than
+bit-equal (`tests/test_ops.py`); the verdict-level contract stays the
+margin-gated escalation of `defense.py`'s "token-exact"/"mixer-exact"
+modes, unchanged.
+
+Gate: callers resolve `use_pallas` through `ops._backend.resolve_use_pallas`
+and pass `interpret=True` on CPU ("interpret" mode, the parity-test path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def masked_kv_attention_reference(q, kd, vd, kc, vc, clean_bias, dirty_bias):
+    """The einsum composition the kernel replaces (q pre-scaled):
+    `q/kd/vd [B, C, S, H, f]`, `kc/vc [B, T, H, f]`, `clean_bias [B, C, T]`,
+    `dirty_bias [B, C, S]` -> `[B, C, S, H, f]`."""
+    t = kc.shape[1]
+    wc = jnp.einsum("bcshf,bthf->bchst", q, kc) \
+        + clean_bias[:, :, None, None, :]
+    wd = jnp.einsum("bcshf,bcthf->bchst", q, kd) \
+        + dirty_bias[:, :, None, None, :]
+    w = jax.nn.softmax(jnp.concatenate([wc, wd], axis=-1), axis=-1)
+    o = jnp.einsum("bchst,bthf->bcshf", w[..., :t], vc) \
+        + jnp.einsum("bchst,bcthf->bcshf", w[..., t:], vd)
+    return o
+
+
+def _attn_kernel(num_heads: int, q_ref, kd_ref, vd_ref, kc_ref, vc_ref,
+                 cb_ref, db_ref, out_ref):
+    cb = cb_ref[0, 0][None, :]                      # [1, T]
+    db = db_ref[0, 0][None, :]                      # [1, S]
+    for h in range(num_heads):                      # tiny H: unrolled
+        qh = q_ref[0, 0, :, h, :]                   # [S, f]
+        wc = jax.lax.dot_general(                   # [S, T]
+            qh, kc_ref[0, :, h, :], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) + cb
+        wd = jax.lax.dot_general(                   # [S, S]
+            qh, kd_ref[0, 0, :, h, :], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) + db
+        m = jnp.maximum(jnp.max(wc, axis=-1), jnp.max(wd, axis=-1))
+        ec = jnp.exp(wc - m[:, None])
+        ed = jnp.exp(wd - m[:, None])
+        denom = jnp.sum(ec, axis=-1) + jnp.sum(ed, axis=-1)
+        o = (jax.lax.dot_general(
+                 ec, vc_ref[0, :, h, :], (((1,), (0,)), ((), ())),
+                 preferred_element_type=jnp.float32)
+             + jax.lax.dot_general(
+                 ed, vd_ref[0, 0, :, h, :], (((1,), (0,)), ((), ())),
+                 preferred_element_type=jnp.float32)) / denom[:, None]
+        out_ref[0, 0, :, h, :] = o.astype(out_ref.dtype)
+
+
+def masked_kv_attention(q, kd, vd, kc, vc, clean_bias, dirty_bias,
+                        interpret: bool = False):
+    """Pallas twin of `masked_kv_attention_reference` (same signature plus
+    `interpret`). Grid (B, C) with the mask axis minor-most: the clean
+    K/V blocks depend only on the image index and stay VMEM-resident
+    across each image's whole mask sweep; every step writes its
+    `[S, H, f]` output directly."""
+    b, c, s, h, f = q.shape
+    t = kc.shape[1]
+    img = lambda i, j: (i, 0, 0, 0)
+    ent = lambda i, j: (i, j, 0, 0, 0)
+    return pl.pallas_call(
+        functools.partial(_attn_kernel, h),
+        grid=(b, c),
+        in_specs=[
+            pl.BlockSpec((1, 1, s, h, f), ent),
+            pl.BlockSpec((1, 1, s, h, f), ent),
+            pl.BlockSpec((1, 1, s, h, f), ent),
+            pl.BlockSpec((1, t, h, f), img),
+            pl.BlockSpec((1, t, h, f), img),
+            pl.BlockSpec((1, 1, t), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, s), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, s, h, f), ent),
+        out_shape=jax.ShapeDtypeStruct((b, c, s, h, f), q.dtype),
+        interpret=interpret,
+    )(q, kd, vd, kc, vc, clean_bias, dirty_bias)

@@ -30,15 +30,36 @@ The fold applies to the mandatory first-round (phase-1) sweep, where every
 window is small. Pair masks' joint receptive fields approach the full
 image (two far-apart rectangles), so phase-2 keeps the standard path —
 see `defense.PatchCleanser._build_pruned_programs`.
+
+Pallas tier (`use_pallas`, same gate as `ops.masked_fill`): the XLA fold
+still materializes each mask's windowed input and delta tensor in HBM
+between ops. `fold_masked_stem_kernel` fuses the whole per-mask chain —
+window gather, occlusion mask, VALID delta-conv, scatter into the
+broadcast clean cache — into one kernel whose grid iterates masks
+minor-most per image, so the padded input and the clean cache stay
+VMEM-resident across the 36-mask sweep and the per-mask delta never
+round-trips through HBM. Mask windows are enlarged to the family-uniform
+`[OH, OW]` bound (start offsets clamped to stay in range; the occlusion
+indicator is laid out in absolute window coordinates, so every output in
+the enlargement but outside the true affected region receives an exactly
+zero delta). The delta-conv runs as the k*k unrolled strided-slice
+matmul accumulation `_delta_conv` — the SAME composition the XLA fold
+uses, in the same order, so kernel and fold outputs are bit-identical on
+f32 (asserted by `tests/test_ops.py`).
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dorpatch_tpu.ops import _backend
 
 
 class _Window(NamedTuple):
@@ -105,6 +126,36 @@ def plan_windows(rects: np.ndarray, img_size: int, k: int, s: int,
     return plan
 
 
+def _delta_conv(win: jax.Array, kernel: jax.Array, s: int) -> jax.Array:
+    """VALID conv `[B, IH, IW, Cin] x [k, k, Cin, Cout] -> [B, OH, OW,
+    Cout]` (stride `s`, f32 accumulation) as the k*k unrolled
+    strided-slice matmul chain. THE delta-conv arithmetic of both the XLA
+    fold and the Pallas kernel — one composition, one summation order
+    (dr-major, dc, then Cin inside each dot), which is what makes the
+    kernel/fold parity contract bit-exact instead of merely allclose. The
+    strided row/col select is a reshape (`[OH, s, ...][:, 0]`), which
+    needs `OH*s + k - 1` rows; windows at the natural VALID size are
+    zero-padded up (the pad rows feed no selected output)."""
+    k = int(kernel.shape[0])
+    b, ih, iw, cin = win.shape
+    oh, ow = (ih - k) // s + 1, (iw - k) // s + 1
+    cout = kernel.shape[-1]
+    eh, ew = oh * s + k - 1, ow * s + k - 1
+    if (eh, ew) != (ih, iw):
+        win = jnp.pad(win, ((0, 0), (0, eh - ih), (0, ew - iw), (0, 0)))
+    acc = jnp.zeros((b, oh * ow, cout), jnp.float32)
+    for dr in range(k):
+        rows = win[:, dr:dr + oh * s].reshape(b, oh, s, ew, cin)[:, :, 0]
+        for dc in range(k):
+            cols = rows[:, :, dc:dc + ow * s] \
+                .reshape(b, oh, ow, s, cin)[:, :, :, 0]
+            acc = acc + jax.lax.dot_general(
+                cols.reshape(b, oh * ow, cin), kernel[dr, dc],
+                (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    return acc.reshape(b, oh, ow, cout)
+
+
 def fold_masked_stem(kernel: jax.Array, clean: jax.Array, u: jax.Array,
                      plan: Sequence[_Window], strides: Tuple[int, int],
                      pads) -> jax.Array:
@@ -112,18 +163,106 @@ def fold_masked_stem(kernel: jax.Array, clean: jax.Array, u: jax.Array,
     `u = norm_scale * (fill - img)` -> `[B, N, h, w, c]` masked stem
     activations: one small VALID delta-conv per mask, scattered into the
     broadcast clean cache. Everything about each mask is static, so the
-    whole fold compiles into one fused program."""
+    whole fold compiles into one fused program. The delta-conv is the
+    shared `_delta_conv` composition (bit-identical to the Pallas
+    kernel's)."""
     (pr0, pr1), (pc0, pc1) = pads
     up = jnp.pad(u, ((0, 0), (pr0, pr1), (pc0, pc1), (0, 0)))
     b = clean.shape[0]
     out = jnp.broadcast_to(clean[:, None], (b, len(plan)) + clean.shape[1:])
     for n, w in enumerate(plan):
         win = up[:, w.i0:w.i1, w.ic0:w.ic1, :] * jnp.asarray(w.occ)
-        d = jax.lax.conv_general_dilated(
-            win, kernel, window_strides=strides, padding="VALID",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        out = out.at[:, n, w.o0:w.o1, w.oc0:w.oc1, :].add(d)
+        d = _delta_conv(win, kernel, int(strides[0]))
+        out = out.at[:, n, w.o0:w.o1, w.oc0:w.oc1, :].add(
+            d.astype(out.dtype))
     return out
+
+
+# ------------------------------------------------------------ Pallas kernel
+
+
+def _uniform_plan(plan: Sequence[_Window], h_out: int, w_out: int,
+                  k: int, s: int):
+    """Family-uniform kernel geometry. Every mask's affected-output window
+    is enlarged to the family max `[OH, OW]` with the start clamped
+    in-range (`o0' = min(o0, h_out - OH)` keeps the input start
+    `o0' * s`-aligned), and the occlusion indicator is re-laid-out in
+    absolute window coordinates over the enlarged `[IH, IW]` input window
+    (`IH = OH*s + k - 1`, sized for `_delta_conv`'s reshape select).
+    Outputs inside the enlargement but outside the mask's true affected
+    region have receptive fields that see only occ=0 input, so their
+    delta is exactly zero — the kernel scatters the whole `[OH, OW]`
+    block without any output masking. Returns `(OH, OW, geo [N, 4] int32
+    rows (o0, oc0, i0, ic0), occ [N, IH, IW] f32)`."""
+    oh = max(w.o1 - w.o0 for w in plan)
+    ow = max(w.oc1 - w.oc0 for w in plan)
+    ih, iw = oh * s + k - 1, ow * s + k - 1
+    geo = np.zeros((len(plan), 4), np.int32)
+    occ = np.zeros((len(plan), ih, iw), np.float32)
+    for n, w in enumerate(plan):
+        o0 = min(w.o0, h_out - oh)
+        oc0 = min(w.oc0, w_out - ow)
+        i0, ic0 = o0 * s, oc0 * s
+        geo[n] = (o0, oc0, i0, ic0)
+        occ[n, w.i0 - i0:w.i1 - i0, w.ic0 - ic0:w.ic1 - ic0] = w.occ[:, :, 0]
+    return oh, ow, geo, occ
+
+
+def _fold_kernel(oh: int, ow: int, k: int, s: int, geo_ref, up_ref,
+                 occ_ref, clean_ref, kern_ref, out_ref):
+    n = pl.program_id(1)
+    ih, iw = occ_ref.shape[1], occ_ref.shape[2]
+    win = up_ref[0, pl.ds(geo_ref[n, 2], ih), pl.ds(geo_ref[n, 3], iw), :] \
+        * occ_ref[0][:, :, None]
+    d = _delta_conv(win[None], kern_ref[...], s)[0]
+    scattered = jax.lax.dynamic_update_slice(
+        jnp.zeros(out_ref.shape[2:], out_ref.dtype),
+        d.astype(out_ref.dtype), (geo_ref[n, 0], geo_ref[n, 1], 0))
+    out_ref[0, 0] = clean_ref[0] + scattered
+
+
+def fold_masked_stem_kernel(kernel: jax.Array, clean: jax.Array,
+                            u: jax.Array, plan: Sequence[_Window],
+                            strides: Tuple[int, int], pads,
+                            interpret: bool = False) -> jax.Array:
+    """Pallas twin of `fold_masked_stem`, bit-identical output: one fused
+    window-gather + occlusion + delta-conv + scatter kernel. The grid
+    iterates masks minor-most per image, so the padded fill-delta input
+    and the clean stem cache load into VMEM once per image and serve the
+    whole mask sweep; each grid step writes its `[h, w, c]` masked
+    activation directly — the per-mask windowed input and delta tensors
+    never exist in HBM."""
+    (pr0, pr1), (pc0, pc1) = pads
+    s = int(strides[0])
+    k = int(kernel.shape[0])
+    b, h, w, c = clean.shape
+    n = len(plan)
+    cin = u.shape[-1]
+    # s-1 extra zero rows/cols keep the clamped uniform windows (and the
+    # reshape select's overhang) in bounds; they feed no real output
+    up = jnp.pad(u, ((0, 0), (pr0, pr1 + s - 1), (pc0, pc1 + s - 1),
+                     (0, 0)))
+    hp, wp = int(up.shape[1]), int(up.shape[2])
+    oh, ow, geo, occ = _uniform_plan(plan, h, w, k, s)
+    return pl.pallas_call(
+        functools.partial(_fold_kernel, oh, ow, k, s),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            # mask axis minor-most: the up/clean blocks index only on the
+            # image axis, so they stay resident across each image's sweep
+            grid=(b, n),
+            in_specs=[
+                pl.BlockSpec((1, hp, wp, cin), lambda i, m, geo: (i, 0, 0, 0)),
+                pl.BlockSpec((1,) + occ.shape[1:], lambda i, m, geo: (m, 0, 0)),
+                pl.BlockSpec((1, h, w, c), lambda i, m, geo: (i, 0, 0, 0)),
+                pl.BlockSpec(tuple(kernel.shape), lambda i, m, geo: (0, 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, h, w, c), lambda i, m, geo: (i, m, 0, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n, h, w, c), clean.dtype),
+        interpret=interpret,
+    )(jnp.asarray(geo), up, jnp.asarray(occ), clean, kernel)
 
 
 def _preds_margins(logits):
@@ -142,11 +281,13 @@ class StemFoldFamily:
     conservatively credited a full forward per entry."""
 
     def __init__(self, engine: "StemFoldEngine", rects: np.ndarray,
-                 num_singles: int, chunk_size: int, fill: float):
+                 num_singles: int, chunk_size: int, fill: float,
+                 use_pallas: str = "auto"):
         self.engine = engine
         self.num_singles = int(num_singles)
         self.chunk_size = max(1, int(chunk_size))
         self.fill = float(fill)
+        self.use_pallas = use_pallas
         self.plan = plan_windows(rects[:num_singles], engine.img_size,
                                  engine.kernel_hw, engine.strides[0],
                                  engine.pads)
@@ -175,11 +316,20 @@ class StemFoldFamily:
         # tail, no padding, no retrace).
         inflation = float(np.prod(clean.shape[1:])) / float(h * w * ci)
         c = max(1, min(n, int(self.chunk_size / max(1.0, inflation))))
+        # kernel tier: resolved at trace time by the shared gate (mesh=None
+        # — meshed certifiers pass use_pallas="off" down build_family, see
+        # defense._build_pruned_programs)
+        mode = _backend.resolve_use_pallas(self.use_pallas)
         preds, margins = [], []
         for off in range(0, n, c):
             part = self.plan[off:off + c]
-            folded = fold_masked_stem(kernel, clean, u, part,
-                                      eng.strides, eng.pads)  # [B, c', ...]
+            if mode == "off":
+                folded = fold_masked_stem(kernel, clean, u, part,
+                                          eng.strides, eng.pads)
+            else:
+                folded = fold_masked_stem_kernel(
+                    kernel, clean, u, part, eng.strides, eng.pads,
+                    interpret=(mode == "interpret"))  # [B, c', ...]
             logits = eng.module.apply(
                 params, folded.reshape((-1,) + folded.shape[2:]), "trunk")
             p, m = _preds_margins(logits)
@@ -217,5 +367,7 @@ class StemFoldEngine:
         self.norm_scale = float(norm_scale)
 
     def build_family(self, rects: np.ndarray, num_singles: int,
-                     chunk_size: int, fill: float) -> StemFoldFamily:
-        return StemFoldFamily(self, rects, num_singles, chunk_size, fill)
+                     chunk_size: int, fill: float,
+                     use_pallas: str = "auto") -> StemFoldFamily:
+        return StemFoldFamily(self, rects, num_singles, chunk_size, fill,
+                              use_pallas=use_pallas)

@@ -183,7 +183,8 @@ class CertifiedInferenceService:
         # from the 36-mask table alone, with round-1-only certificates)
         self.prune = (self.defenses[0].resolved_prune()
                       if self.defenses else "off")
-        # effective incremental mode (off | token | token-exact | stem):
+        # effective incremental mode (off | token | token-exact | mixer
+        # | mixer-exact | stem):
         # with an engine attached the pruned-path programs are the
         # engine-backed twins, and the per-request certify cost lands in
         # `certify_forward_equivalents` as fractional full forwards

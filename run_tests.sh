@@ -81,6 +81,18 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   | grep -q '"parity": true' \
   || { echo "certify-incr smoke: parity/forward-equivalents violation"; exit 1; }
 echo "certify incr smoke: OK"
+# Smoke: the Pallas kernel tier — the same seeded batch through the
+# engine-backed pruned certify with use_pallas="off" (pure XLA) and
+# use_pallas="interpret" (the kernel bodies emulated on CPU; the lowered
+# TPU path shares them) must agree per each kernel's exactness contract
+# (stem/mixer bit-identical, token verdict parity), with ZERO recompiles
+# on the kernel side under the armed watchdog (tools/kernel_smoke.py
+# exits non-zero and lists the violations otherwise).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/kernel_smoke.py \
+  | grep -q '"parity": true' \
+  || { echo "kernel smoke: kernel-tier parity/recompile violation"; exit 1; }
+echo "kernel smoke: OK"
 # Smoke: sharded pruned certification — the same seeded stub batch through
 # the single-chip pruned oracle, the meshed exhaustive sweep, and the meshed
 # two-phase pruned schedule (phase-2 worklists planned shard-locally,

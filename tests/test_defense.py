@@ -467,6 +467,23 @@ def tiny_conv():
         "cifar_resnet18", module, INCR_IMG)
 
 
+@pytest.fixture(scope="module")
+def tiny_mixer():
+    from dorpatch_tpu.models.registry import incremental_engine
+    from dorpatch_tpu.models.resmlp import ResMLP
+
+    module = ResMLP(num_classes=INCR_CLASSES, patch_size=4, dim=32,
+                    depth=2, img_size=INCR_IMG)
+    params = module.init(jax.random.PRNGKey(9),
+                         jnp.zeros((1, INCR_IMG, INCR_IMG, 3)))
+
+    def apply_fn(p, x):
+        return module.apply(p, (x - 0.5) / 0.5)
+
+    return params, apply_fn, incremental_engine("cifar_resmlp", module,
+                                                INCR_IMG)
+
+
 def _incr_pair(apply_fn, engine, ratio, incremental="auto",
                margin=0.5, num_axis=INCR_AXIS, recompile_budget=None):
     spec = masks_lib.geometry(INCR_IMG, ratio, num_mask_per_axis=num_axis)
@@ -547,6 +564,40 @@ def test_token_fe_strictly_below_forwards(tiny_vit):
     params, apply_fn, engine = tiny_vit
     _, incr = _incr_pair(apply_fn, engine, 0.1, incremental="token")
     assert incr.resolved_incremental() == "token"
+    got = incr.robust_predict(params, _incr_batch(), INCR_CLASSES,
+                              bucket_sizes=(1, 4))
+    for g in got:
+        assert 0 < g.forward_equivalents < g.forwards
+    assert incr.first_round_forward_equivalents < incr.num_first
+
+
+def test_mixer_exact_verdicts_bit_identical(tiny_mixer):
+    """The ResMLP mixer engine rides the same margin-gated contract as the
+    token engine: "mixer-exact" with an infinite margin escalates every
+    image — verdicts AND tables bit-identical to the exhaustive oracle."""
+    params, apply_fn, engine = tiny_mixer
+    assert engine.kind == "mixer"
+    oracle, incr = _incr_pair(apply_fn, engine, 0.1,
+                              incremental="mixer-exact",
+                              margin=float("inf"))
+    assert incr.resolved_incremental() == "mixer-exact"
+    assert incr.resolved_incremental("auto") == "mixer-exact"
+    x = _incr_batch()
+    want = oracle.robust_predict(params, x, INCR_CLASSES)
+    got = incr.robust_predict(params, x, INCR_CLASSES, bucket_sizes=(1, 4))
+    for i, (w, g) in enumerate(zip(want, got)):
+        assert (g.prediction, g.certification) == \
+            (w.prediction, w.certification), f"image {i}"
+        np.testing.assert_array_equal(g.preds_1, w.preds_1)
+        np.testing.assert_array_equal(g.preds_2, w.preds_2)
+
+
+def test_mixer_fe_strictly_below_forwards(tiny_mixer):
+    """Plain "mixer": forward_equivalents credits the dirty-row fraction
+    of each evaluated entry — strictly below the entry count."""
+    params, apply_fn, engine = tiny_mixer
+    _, incr = _incr_pair(apply_fn, engine, 0.1, incremental="mixer")
+    assert incr.resolved_incremental() == "mixer"
     got = incr.robust_predict(params, _incr_batch(), INCR_CLASSES,
                               bucket_sizes=(1, 4))
     for g in got:

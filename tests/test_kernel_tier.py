@@ -1,0 +1,186 @@
+"""CPU-interpreter parity for the Pallas kernel tier and its gate.
+
+Runs the kernels in interpreter mode (tests force the CPU platform, see
+conftest.py); the lowered TPU path shares the same kernel bodies. The two
+kernels carry different exactness contracts, asserted here at their full
+strength: the stem delta-conv kernel shares `_delta_conv` (one composition,
+one summation order) with the XLA fold, so its output is BIT-exact at f32;
+the masked-KV attention kernel re-derives the softmax as a two-group
+running max, so its contract is allclose at f32 ULP scale — the engine
+layers margin gating on top (tests/test_defense.py). The mixer engine has
+no kernel; its frozen-clean-rows exactness is asserted against a dense
+reference here, verdict-level contracts in tests/test_defense.py.
+"""
+
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dorpatch_tpu.ops import _backend
+from dorpatch_tpu.ops.masked_kv_attn import (masked_kv_attention,
+                                             masked_kv_attention_reference)
+from dorpatch_tpu.ops.stem_fold import (fold_masked_stem,
+                                        fold_masked_stem_kernel,
+                                        plan_windows, same_pads)
+
+IMG = 16
+
+
+def _rect_table():
+    # corner / interior / far-corner / edge boxes: distinct window shapes,
+    # so the uniform-plan enlargement + start clamping all get exercised
+    return np.array([[[0, 5, 0, 5]], [[3, 9, 2, 8]],
+                     [[10, 16, 11, 16]], [[0, 4, 12, 16]]], np.int64)
+
+
+@pytest.mark.parametrize("k,s,pad", [(3, 1, ((1, 1), (1, 1))),
+                                     (3, 2, "same"), (5, 2, "same")])
+def test_stem_kernel_bit_exact(k, s, pad):
+    """The CifarResNet18 (3x3/s1) and BiT-stem-like (strided SAME)
+    geometries: kernel output must equal the fold EXACTLY, not allclose."""
+    if pad == "same":
+        pad = (same_pads(IMG, k, s), same_pads(IMG, k, s))
+    plan = plan_windows(_rect_table(), IMG, k, s, pad)
+    (pr0, pr1), _ = pad
+    h = (IMG + pr0 + pr1 - k) // s + 1
+    kern = jax.random.normal(jax.random.PRNGKey(0), (k, k, 3, 8))
+    clean = jax.random.normal(jax.random.PRNGKey(1), (2, h, h, 8))
+    u = jax.random.normal(jax.random.PRNGKey(2), (2, IMG, IMG, 3))
+    ref = fold_masked_stem(kern, clean, u, plan, (s, s), pad)
+    got = fold_masked_stem_kernel(kern, clean, u, plan, (s, s), pad,
+                                  interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_attention_kernel_matches_reference():
+    """Two-group softmax vs the einsum/concat composition, with real
+    −1e9 bias patterns (clean columns masked where dirty, dup dirty
+    slots masked) — f32 ULP scale, far inside the engine's margin gate."""
+    b, c, s, h, f, t = 2, 3, 4, 2, 8, 9
+    ks = jax.random.split(jax.random.PRNGKey(3), 7)
+    q = jax.random.normal(ks[0], (b, c, s, h, f))
+    kd = jax.random.normal(ks[1], (b, c, s, h, f))
+    vd = jax.random.normal(ks[2], (b, c, s, h, f))
+    kc = jax.random.normal(ks[3], (b, t, h, f))
+    vc = jax.random.normal(ks[4], (b, t, h, f))
+    clean_bias = jnp.where(jax.random.bernoulli(ks[5], 0.2, (b, c, t)),
+                           -1e9, 0.0)
+    dirty_bias = jnp.where(jax.random.bernoulli(ks[6], 0.25, (b, c, s)),
+                           -1e9, 0.0)
+    # the engine never masks every dirty slot of an entry; keep slot 0 live
+    dirty_bias = dirty_bias.at[:, :, 0].set(0.0)
+    ref = masked_kv_attention_reference(q, kd, vd, kc, vc,
+                                        clean_bias, dirty_bias)
+    got = masked_kv_attention(q, kd, vd, kc, vc, clean_bias, dirty_bias,
+                              interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_resolve_use_pallas_gate():
+    """The shared gate: "auto" stays off on CPU hosts (the tests' own
+    platform), explicit modes pass through, multi-device meshes fall back
+    to XLA when the op can't shard."""
+    assert _backend.resolve_use_pallas("auto") == "off"
+    assert _backend.resolve_use_pallas("on") == "on"
+    assert _backend.resolve_use_pallas("off") == "off"
+    assert _backend.resolve_use_pallas("interpret") == "interpret"
+    with pytest.raises(ValueError):
+        _backend.resolve_use_pallas("maybe")
+    fake_mesh = types.SimpleNamespace(devices=np.empty((2, 1), object))
+    assert _backend.resolve_use_pallas("on", mesh=fake_mesh,
+                                       divisible=False) == "off"
+    assert _backend.resolve_use_pallas("interpret", mesh=fake_mesh,
+                                       divisible=True) == "interpret"
+
+
+# ---------------------------------------------------------- mixer engine
+
+
+def test_mixer_engine_matches_frozen_clean_reference():
+    """phase1/rows vs a dense frozen-clean-rows reference: per block,
+    overwrite the cached clean activations' dirty rows with the engine's
+    current values, run the FULL block, re-extract the dirty rows — the
+    exactness contract the mixer engine documents (clean rows frozen at
+    cache values; everything else exact)."""
+    from dorpatch_tpu import masks as masks_lib
+    from dorpatch_tpu.models.resmlp import MixerPrunedResMLP, ResMLP
+
+    patch, dim, depth, nc = 4, 32, 3, 10
+    mod = ResMLP(num_classes=nc, patch_size=patch, dim=dim, depth=depth,
+                 img_size=IMG)
+    params = mod.init(jax.random.PRNGKey(0), jnp.zeros((1, IMG, IMG, 3)))
+    imgs = jax.random.uniform(jax.random.PRNGKey(1), (2, IMG, IMG, 3))
+    rects = _rect_table()
+    eng = MixerPrunedResMLP(mod, IMG)
+    assert eng.kind == "mixer"
+    fam = eng.build_family(rects, num_singles=len(rects), chunk_size=3,
+                           fill=0.3)
+    preds, margins = jax.jit(fam.phase1)(params, imgs)
+
+    p = params["params"]
+
+    def block_fwd(bp, x):
+        y = bp["norm1"]["alpha"] * x + bp["norm1"]["beta"]
+        z = jnp.einsum("btd,tu->bud", y, bp["linear_tokens"]["kernel"]) \
+            + bp["linear_tokens"]["bias"][None, :, None]
+        x = x + bp["ls1"] * z
+        y = bp["norm2"]["alpha"] * x + bp["norm2"]["beta"]
+        h = jax.nn.gelu(y @ bp["mlp_fc1"]["kernel"] + bp["mlp_fc1"]["bias"],
+                        approximate=False)
+        return x + bp["ls2"] * (h @ bp["mlp_fc2"]["kernel"]
+                                + bp["mlp_fc2"]["bias"])
+
+    def embed(x):
+        pt = eng._patches(x)
+        return jnp.einsum("bthwc,hwcd->btd", eng.normalize(pt),
+                          p["patch_embed"]["kernel"]) + p["patch_embed"]["bias"]
+
+    xs, _zs, xf = mod.apply(params, eng.normalize(imgs), "cache")
+    cov = masks_lib.rect_token_coverage(rects, IMG, patch)
+    ref_logits = np.zeros((2, len(rects), nc), np.float32)
+    for n in range(len(rects)):
+        r0, r1, c0, c1 = rects[n, 0]
+        m = imgs.at[:, r0:r1, c0:c1, :].set(0.3)
+        dirty = np.nonzero(cov[n])[0]
+        d = embed(m)[:, dirty]
+        for layer in range(depth):
+            d = block_fwd(p[f"block{layer}"],
+                          xs[layer].at[:, dirty].set(d))[:, dirty]
+        xfin = xf.at[:, dirty].set(d)
+        pooled = (p["norm"]["alpha"] * xfin + p["norm"]["beta"]).mean(axis=1)
+        ref_logits[:, n] = np.asarray(pooled @ p["head"]["kernel"]
+                                      + p["head"]["bias"])
+
+    order = np.argsort(ref_logits, axis=-1)
+    ref_preds = order[..., -1]
+    ref_m = np.take_along_axis(ref_logits, order[..., -1:], -1)[..., 0] \
+        - np.take_along_axis(ref_logits, order[..., -2:-1], -1)[..., 0]
+    np.testing.assert_array_equal(np.asarray(preds), ref_preds)
+    np.testing.assert_allclose(np.asarray(margins), ref_m,
+                               atol=1e-5, rtol=1e-5)
+
+    # the ragged rows program reuses the combined tables: same contract
+    sets_idx = jnp.asarray([[0, 2], [1, 3]], jnp.int32)
+    pr, mr = jax.jit(fam.rows)(params, imgs, sets_idx)
+    for wrow in range(2):
+        for j in range(2):
+            n = int(sets_idx[wrow, j])
+            assert int(pr[wrow, j]) == ref_preds[wrow, n]
+            assert abs(float(mr[wrow, j]) - ref_m[wrow, n]) < 1e-4
+
+
+def test_mixer_engine_registry_resolution():
+    """Both ResMLP names resolve the mixer engine; non-grid-aligned input
+    resolves none (no token geometry)."""
+    from dorpatch_tpu.models import registry
+
+    for arch, img in (("cifar_resmlp", 32), ("resmlp_24_distilled_224", 224)):
+        mod = registry.build_bare_model(arch, 10)
+        eng = registry.incremental_engine(arch, mod, img)
+        assert eng is not None and eng.kind == "mixer", arch
+    mod = registry.build_bare_model("cifar_resmlp", 10)
+    assert registry.incremental_engine("cifar_resmlp", mod, 33) is None

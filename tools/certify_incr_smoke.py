@@ -2,7 +2,7 @@
 """Incremental-certify smoke: engine-backed vs PR 5 pruned-only double
 masking on a seeded batch (CI gate, `run_tests.sh`).
 
-Two legs, one per engine family, at the production 36-mask geometry:
+Three legs, one per engine family, at the production 36-mask geometry:
 
 - token (small ViT victim): `DefenseConfig.incremental="token"` must yield
   the same verdicts as the pruned-only path on the seeded batch (the batch
@@ -10,6 +10,9 @@ Two legs, one per engine family, at the production 36-mask geometry:
   tolerance-contracted, verdict-level checked here) while executing
   STRICTLY LOWER forward-equivalents — the fractional full-forward cost
   the token engine records per entry.
+- mixer (small ResMLP victim): same contract as the token leg — the
+  mixer engine's dirty-row tracking is tolerance-contracted per entry,
+  verdict parity and strictly lower forward-equivalents checked here.
 - stem (CifarResNet18 victim): the masked-stem fold is algebraically
   exact — verdicts and every evaluated second-round entry bit-identical.
 
@@ -88,6 +91,37 @@ def main(argv=None) -> int:
                   "fe_pruned_only": round(fe_pruned, 1),
                   "fe_first_round_token": round(
                       token.first_round_forward_equivalents, 2)})
+
+    # ---- mixer leg (small ResMLP) ----
+    from dorpatch_tpu.models.resmlp import ResMLP
+
+    mlp = ResMLP(num_classes=n_classes, patch_size=4, dim=32, depth=2,
+                 img_size=img)
+    # noqa-reason: the smoke's whole point is a pinned, reproducible victim
+    mparams = mlp.init(jax.random.PRNGKey(7),  # noqa: DP104 fixed smoke seed
+                       jnp.zeros((1, img, img, 3)))
+
+    def mapply(p, xx):
+        return mlp.apply(p, (xx - 0.5) / 0.5)
+
+    mengine = incremental_engine("resmlp_24_distilled_224", mlp, img)
+    mpruned = build(mapply, None, "off")
+    mixer = build(mapply, mengine, "mixer")
+    mwant = mpruned.robust_predict(mparams, x, n_classes, bucket_sizes=(1, 4))
+    mgot = mixer.robust_predict(mparams, x, n_classes, bucket_sizes=(1, 4))
+    for i, (w, g) in enumerate(zip(mwant, mgot)):
+        if (w.prediction, w.certification) != (g.prediction,
+                                               g.certification):
+            failures.append(f"mixer image {i}: verdict "
+                            f"({w.prediction}, {w.certification}) != "
+                            f"({g.prediction}, {g.certification})")
+    fe_mixer = sum(r.forward_equivalents for r in mgot)
+    fe_mpruned = sum(r.forward_equivalents for r in mwant)
+    if not fe_mixer < fe_mpruned:
+        failures.append(f"mixer path not cheaper: {fe_mixer} "
+                        f"forward-equivalents vs pruned-only {fe_mpruned}")
+    stats.update({"fe_mixer": round(fe_mixer, 1),
+                  "fe_mixer_pruned_only": round(fe_mpruned, 1)})
 
     # ---- stem leg (CifarResNet18, exact) ----
     conv = CifarResNet18(num_classes=n_classes)

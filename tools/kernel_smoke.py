@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Pallas kernel-tier smoke: interpret-mode kernels vs the XLA tier
+through the full pruned+incremental certify (CI gate, `run_tests.sh`).
+
+The engines gate their kernels behind `DefenseConfig.use_pallas`; on the
+CPU CI host "auto" resolves off, so this smoke pins the gate explicitly:
+the SAME seeded batch through the same engine-backed schedule at
+`use_pallas="off"` (pure XLA) and `use_pallas="interpret"` (the kernel
+bodies emulated on CPU — the lowered TPU path shares them). One leg per
+engine family:
+
+- stem (CifarResNet18): the kernel shares `_delta_conv` with the fold —
+  verdicts, first-round tables and every evaluated second-round entry
+  must be BIT-identical.
+- token (small ViT): the attention kernel is tolerance-contracted —
+  verdict parity checked here (entry drift sits at f32 ULP scale, far
+  under the margin gate; tests/test_kernel_tier.py asserts the tensor
+  contract).
+- mixer (small ResMLP): no kernel of its own — the gate must pass
+  through as a no-op (bit-identical verdicts), guarding the plumbing.
+
+The interpret side then proves the serving contract: after `warm_pruned`
+at the smoke buckets, ragged traffic retraces NOTHING under the ARMED
+recompile watchdog (`recompile_budget`).
+
+Prints ONE JSON line: {"metric": "kernel_smoke", "parity": true, ...};
+exits non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu import masks as masks_lib
+    from dorpatch_tpu.config import DefenseConfig
+    from dorpatch_tpu.defense import UNEVALUATED, PatchCleanser
+    from dorpatch_tpu.models.registry import incremental_engine
+    from dorpatch_tpu.models.resmlp import ResMLP
+    from dorpatch_tpu.models.small import CifarResNet18
+    from dorpatch_tpu.models.vit import ViT
+
+    img, n_classes, ratio = 32, 3, 0.1
+    buckets = (1, 3)
+    spec = masks_lib.geometry(img, ratio)
+    rng = np.random.default_rng(1234)
+    imgs = rng.uniform(0.0, 1.0, (3, img, img, 3)).astype(np.float32)
+    imgs[0] = 0.5                 # gray: provably first-round unanimous
+    imgs[1, :6, :6, :] = 1.0      # bright corner: disagreement inducer
+    x = jnp.asarray(imgs)
+
+    failures = []
+    stats = {"metric": "kernel_smoke", "images": int(x.shape[0])}
+
+    def build(apply_fn, engine, incremental, use_pallas):
+        return PatchCleanser(
+            apply_fn, spec,
+            DefenseConfig(ratios=(ratio,), prune="exact",
+                          incremental=incremental, use_pallas=use_pallas),
+            incremental_engine=engine,
+            recompile_budget=len(buckets) + 1)
+
+    def leg(name, apply_fn, engine, incremental, params, exact):
+        xla = build(apply_fn, engine, incremental, "off")
+        kern = build(apply_fn, engine, incremental, "interpret")
+        want = xla.robust_predict(params, x, n_classes, bucket_sizes=buckets)
+        # warm the kernel side FIRST, then require traffic (including a
+        # ragged 2-image batch) to retrace nothing with the watchdog armed
+        kern.warm_pruned(params, buckets, num_classes=n_classes)
+        warm_counts = kern.pruned_trace_counts()
+        got = kern.robust_predict(params, x, n_classes, bucket_sizes=buckets)
+        kern.robust_predict(params, x[:2], n_classes, bucket_sizes=buckets)
+        if kern.pruned_trace_counts() != warm_counts:
+            failures.append(f"{name}: kernel path retraced under the armed "
+                            f"watchdog: {warm_counts} -> "
+                            f"{kern.pruned_trace_counts()}")
+        for i, (w, g) in enumerate(zip(want, got)):
+            if (w.prediction, w.certification) != (g.prediction,
+                                                   g.certification):
+                failures.append(f"{name} image {i}: verdict "
+                                f"({w.prediction}, {w.certification}) != "
+                                f"({g.prediction}, {g.certification})")
+            if exact:
+                if not np.array_equal(w.preds_1, g.preds_1):
+                    failures.append(f"{name} image {i}: first-round tables "
+                                    "differ (bit-exact contract)")
+                ev = g.preds_2 != UNEVALUATED
+                if not np.array_equal(w.preds_2[ev], g.preds_2[ev]):
+                    failures.append(f"{name} image {i}: evaluated "
+                                    "second-round entries differ")
+        stats[f"{name}_verdicts"] = [[int(g.prediction),
+                                      bool(g.certification)] for g in got]
+
+    # ---- stem leg (bit-exact kernel contract) ----
+    conv = CifarResNet18(num_classes=n_classes)
+    cparams = conv.init(jax.random.PRNGKey(6),  # noqa: DP104 fixed smoke seed
+                        jnp.zeros((1, img, img, 3)))
+    leg("stem", lambda p, xx: conv.apply(p, (xx - 0.5) / 0.5),
+        incremental_engine("cifar_resnet18", conv, img), "stem",
+        cparams, exact=True)
+
+    # ---- token leg (margin-contracted attention kernel) ----
+    vit = ViT(num_classes=n_classes, patch_size=4, dim=32, depth=2,
+              num_heads=2, img_size=(img, img))
+    vparams = vit.init(jax.random.PRNGKey(5),  # noqa: DP104 fixed smoke seed
+                       jnp.zeros((1, img, img, 3)))
+    leg("token", lambda p, xx: vit.apply(p, (xx - 0.5) / 0.5),
+        incremental_engine("cifar_vit", vit, img), "token",
+        vparams, exact=False)
+
+    # ---- mixer leg (gate pass-through, no kernel) ----
+    mlp = ResMLP(num_classes=n_classes, patch_size=4, dim=32, depth=2,
+                 img_size=img)
+    mparams = mlp.init(jax.random.PRNGKey(7),  # noqa: DP104 fixed smoke seed
+                       jnp.zeros((1, img, img, 3)))
+    leg("mixer", lambda p, xx: mlp.apply(p, (xx - 0.5) / 0.5),
+        incremental_engine("cifar_resmlp", mlp, img), "mixer",
+        mparams, exact=True)
+
+    stats.update({"parity": not failures, "failures": failures})
+    print(json.dumps(stats))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
