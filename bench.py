@@ -37,7 +37,9 @@ are real but not "useful" — MFU is reported on the 3x count either way.
 Env overrides: BENCH_MODE ("attack" default; "certify" times the
 PatchCleanser 666-mask certification path instead — see `_certify_bench`;
 "boot" measures cold vs AOT-warm serve boot wall-clock against a throwaway
-executable store — see `child_boot`),
+executable store — see `child_boot`; "recert" measures one full
+re-certification generation — grid submit -> in-process farm drain ->
+harvest/fold/verdict — on the tiny synthetic victim, see `child_recert`),
 BENCH_BATCH (default 4), BENCH_EOT (128 — the reference sampling_size;
 r03 measured batch 4 x EOT 128 fitting v5e HBM without remat), BENCH_BLOCK (8 steps
 per jitted block), BENCH_REPS (3 timed blocks), BENCH_WARMUP (3 untimed
@@ -868,6 +870,53 @@ def child_boot() -> None:
         shutil.rmtree(store_dir, ignore_errors=True)
 
 
+def child_recert() -> None:
+    """BENCH_MODE=recert child: wall-clock of ONE full re-certification
+    generation — grid submit, an in-process farm worker draining the real
+    sweep jobs, rows harvest, baseline fold, verdict — on the tiny
+    synthetic victim. Prints {"generation_s": ..., "jobs": ..., ...}."""
+    import shutil
+    import tempfile
+
+    from dorpatch_tpu.farm.worker import FarmWorker
+    from dorpatch_tpu.recert.scheduler import RecertScheduler
+
+    attack = {"sampling_size": 4, "max_iterations": 4, "sweep_interval": 2,
+              "switch_iteration": 2, "dropout": 1, "dropout_sizes": [0.06],
+              "basic_unit": 4}
+    spec = {
+        "base": {"dataset": "cifar10", "base_arch": "resnet18",
+                 "img_size": 32, "batch_size": 2, "synthetic_data": True,
+                 "attack": attack},
+        "axes": {"attack.patch_budget": [0.06, 0.12]},
+        "sweep": {"densities": [0.0], "structureds": [1e-3],
+                  "defense_ratio": 0.06},
+        "max_attempts": 2,
+    }
+    workdir = tempfile.mkdtemp(prefix="bench-recert-")
+    try:
+        sched = RecertScheduler(
+            os.path.join(workdir, "recert"),
+            baseline_file=os.path.join(workdir, "robustness_baseline.json"))
+        t0 = time.perf_counter()
+        gen, farm_dir = sched.begin_generation(spec)
+        FarmWorker(farm_dir, worker_id="bench", lease_ttl=30.0,
+                   poll_interval=0.1, heartbeat_interval=0.5).run()
+        verdict = sched.complete_generation(gen, farm_dir,
+                                            update_baseline=True)
+        gen_s = time.perf_counter() - t0
+        jobs = int(sched.counts(farm_dir)["done"])
+        print(json.dumps({
+            "generation_s": round(gen_s, 3),
+            "jobs": jobs,
+            "jobs_per_hour": round(jobs * 3600.0 / gen_s, 1) if gen_s else 0.0,
+            "cells": len(verdict.get("cells", {})),
+            "status": verdict.get("status"),
+        }))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def no_axon_env() -> dict:
     """Env that forces plain CPU jax: axon plugin off the path, cpu platform."""
     pp = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
@@ -882,11 +931,47 @@ def no_axon_env() -> dict:
 def main() -> None:
     # empty string = unset (the same convention as PALLAS_AXON_POOL_IPS)
     mode = os.environ.get("BENCH_MODE") or "attack"
-    if mode not in ("attack", "certify", "boot"):
+    if mode not in ("attack", "certify", "boot", "recert"):
         print(json.dumps({"metric": "patch-opt images/sec", "value": 0.0,
                           "unit": "images/sec", "vs_baseline": 0.0,
-                          "error": f"unknown BENCH_MODE={mode!r} "
-                                   "(use 'attack', 'certify' or 'boot')"}))
+                          "error": f"unknown BENCH_MODE={mode!r} (use "
+                                   "'attack', 'certify', 'boot' or "
+                                   "'recert')"}))
+        return
+    if mode == "recert":
+        # One full re-certification generation end to end. One CPU child
+        # (the scheduler/farm layer is host-side; CPU keeps the row
+        # reproducible and independent of tunnel health), no torch baseline
+        # — the row's payload is the generation wall-clock and jobs/hour.
+        recert_metric = ("recert generation seconds (2-job grid, tiny "
+                         "synthetic victim, in-process worker)")
+        res, why, _tail = run_child(
+            "recert", int(os.environ.get("BENCH_JAX_TIMEOUT", "1800")),
+            no_axon_env())
+        if res is None:
+            print(json.dumps({"metric": recert_metric, "value": 0.0,
+                              "unit": "seconds", "vs_baseline": 0.0,
+                              "error": f"recert child failed ({why})"}))
+            return
+        out = {
+            "metric": recert_metric,
+            "value": res["generation_s"],
+            "unit": "seconds",
+            "vs_baseline": 0.0,
+            "jobs": res.get("jobs"),
+            "jobs_per_hour": res.get("jobs_per_hour"),
+            "cells": res.get("cells"),
+            "status": res.get("status"),
+        }
+        try:
+            from dorpatch_tpu.analysis.baseline import program_set_stamp
+
+            stamp = program_set_stamp()
+            if stamp is not None:
+                out["program_set"] = stamp
+        except Exception:
+            pass
+        print(json.dumps(out))
         return
     if mode == "boot":
         # Cold vs AOT-warm serve boot on one throwaway store. One CPU child
@@ -1145,5 +1230,7 @@ if __name__ == "__main__":
         child_torch()
     elif role == "boot":
         child_boot()
+    elif role == "recert":
+        child_recert()
     else:
         main()

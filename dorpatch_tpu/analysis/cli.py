@@ -100,6 +100,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --baseline check: also write the machine-"
                         "readable result as baseline_check.json into this "
                         "directory (the telemetry report renders it)")
+    p.add_argument("--allow-remove", action="store_true",
+                   help="with --baseline update: accept dropping entries "
+                        "that exist in the checked-in file but not in the "
+                        "regenerated set. Without it, update REFUSES when "
+                        "entries would disappear — the usual cause is a "
+                        "single-device regeneration silently losing the "
+                        ".mesh entries (run under XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=8)")
     p.add_argument("--fix", action="store_true",
                    help="apply the DP106 unused-import fixer to the "
                         "target paths (idempotent)")
@@ -247,7 +255,7 @@ def _run_trace(select: Optional[List[str]], spec: str,
 
 def _run_baseline(mode: str, select: Optional[List[str]], spec: str,
                   fmt: str, cost: str, file_override: str,
-                  report_dir: str) -> int:
+                  report_dir: str, allow_remove: bool = False) -> int:
     from dorpatch_tpu.analysis import baseline
 
     loaded = _load_entrypoints(spec)
@@ -267,6 +275,23 @@ def _run_baseline(mode: str, select: Optional[List[str]], spec: str,
             sys.stderr.write(
                 f"--baseline update: {len(findings)} entry point(s) failed "
                 "to trace; baseline NOT written\n")
+            return 1
+        old = baseline.load_baseline(path)
+        removed = sorted(set((old or {}).get("entries", {}))
+                         - set(data.get("entries", {})))
+        if removed and not allow_remove:
+            # regenerating on the wrong topology (no 8-device virtual
+            # mesh) silently drops every .mesh-tagged entry and turns the
+            # gate vacuous exactly where it matters — make the shrink loud
+            sys.stderr.write(
+                f"--baseline update: would drop {len(removed)} baselined "
+                f"entry point(s): {', '.join(removed[:5])}"
+                + (" ..." if len(removed) > 5 else "") + "\n"
+                "Likely cause: regeneration without the baseline's device "
+                "topology (run under XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8). Pass "
+                "--allow-remove if the removal is intentional; baseline "
+                "NOT written\n")
             return 1
         text = baseline.dump_baseline(data)
         try:
@@ -335,7 +360,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.baseline:
         return _run_baseline(args.baseline, select, args.entrypoints,
                              args.format, args.baseline_cost,
-                             args.baseline_file, args.baseline_report)
+                             args.baseline_file, args.baseline_report,
+                             args.allow_remove)
     if args.trace:
         return _run_trace(select, args.entrypoints, args.format)
     try:

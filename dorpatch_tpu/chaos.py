@@ -42,6 +42,19 @@ exception guard):
 Serve faults always target replica 0 — the smoke's assertions need a known
 victim, and determinism beats configurability here.
 
+Recert faults (wired by `recert/scheduler.py` at the cycle's one
+crash-interesting boundary, `on_recert` — called right after the
+generation's grid is submitted, with the inflight record already
+committed to `recert_state.json`):
+
+- ``recert_kill_cycle`` — SIGKILL the scheduler mid-generation: jobs
+  submitted, nothing harvested. Resume must pick up the SAME in-flight
+  generation (never resubmit a new one) and complete it with a baseline
+  byte-identical to an uninterrupted run.
+- ``recert_torn_state`` — truncate `recert_state.json` mid-byte (a torn
+  write): the scheduler must recover the generation counter and the
+  in-flight record from the generation dirs' completion markers.
+
 The harness holds no global state: construct one `Chaos` per job attempt
 (or per serve run), `bind` the worker's heartbeat, and wire the sites.
 """
@@ -58,7 +71,9 @@ from typing import IO, Optional, Sequence
 FARM_FAULTS = ("crash_block", "ckpt_raise", "wedge_heartbeat",
                "enospc_events")
 SERVE_FAULTS = ("wedge_dispatch", "raise_in_worker", "wedge_heartbeat")
-FAULTS = FARM_FAULTS + ("wedge_dispatch", "raise_in_worker")
+RECERT_FAULTS = ("recert_kill_cycle", "recert_torn_state")
+FAULTS = (FARM_FAULTS + ("wedge_dispatch", "raise_in_worker")
+          + RECERT_FAULTS)
 
 # The replica every serve fault is aimed at (see module docstring).
 SERVE_TARGET_REPLICA = 0
@@ -181,6 +196,35 @@ class Chaos:
             return
         event_log._fh = _ENOSPCFile(event_log._fh,
                                     self.events_write_budget())
+
+    # ---------------- recert injection site ----------------
+
+    def on_recert(self, phase: str, state_path: str = "") -> None:
+        """Scheduler cycle-boundary site — called by
+        `RecertScheduler.begin_generation` right after `submit_spec`, with
+        the inflight record already committed. The one place a crash is
+        interesting: earlier there is nothing to resume, later the farm's
+        own crash story covers it."""
+        if phase != "submitted":
+            return
+        if ("recert_torn_state" in self.faults and state_path
+                and self.fire_once("recert_torn_state")):
+            # Tear the state file the way a crashed non-atomic writer
+            # would: keep the first half of the bytes, drop the rest.
+            try:
+                with open(state_path, "rb") as fh:
+                    raw = fh.read()
+                with open(state_path, "wb") as fh:
+                    fh.write(raw[:max(1, len(raw) // 2)])
+            except OSError:
+                pass
+        if ("recert_kill_cycle" in self.faults
+                and self.fire_once("recert_kill_cycle")):
+            if self.crash_mode == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            raise SimulatedPreemption(
+                "chaos: simulated scheduler preemption mid-generation "
+                "(grid submitted, nothing harvested)")
 
     # ---------------- serve injection site ----------------
 

@@ -9,7 +9,8 @@ from __future__ import annotations
 import argparse
 
 from dorpatch_tpu.config import (AotConfig, AttackConfig, DefenseConfig,
-                                 ExperimentConfig, FarmConfig, ServeConfig)
+                                 ExperimentConfig, FarmConfig, RecertConfig,
+                                 ServeConfig)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -201,6 +202,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "the deploy mode, failing boot on any miss so a "
                         "fleet restart either comes up warm with zero "
                         "traces or visibly refuses")
+    # continuous re-certification (`python -m dorpatch_tpu.recert` runs the
+    # scheduler; serve consults its published verdict at boot)
+    p.add_argument("--recert-dir", default="",
+                   help="recert directory holding the scheduler's published "
+                        "robustness verdict (recert_verdict.json); enables "
+                        "GET /robustness on the serve front-end "
+                        "('' = no robustness surface)")
+    p.add_argument("--recert-baseline", default="",
+                   help="robustness baseline file override ('' = the "
+                        "package's recert/robustness_baseline.json)")
+    p.add_argument("--require-recert", default="off",
+                   choices=["off", "warn", "strict"],
+                   help="serve-boot robustness gate against the recert "
+                        "verdict: 'warn' serves on a failing/stale verdict "
+                        "but reports it (canary mode); 'strict' is the "
+                        "deploy mode — boot refuses serving-ready with a "
+                        "typed error unless the verdict exists and is ok, "
+                        "so a fleet never serves silently-uncertified "
+                        "(mirrors --aot strict)")
     # farm (`python -m dorpatch_tpu.farm` shares these defaults; setting
     # them here persists them into the config record a spec's `base` carries)
     p.add_argument("--farm-lease-ttl", type=float, default=60.0,
@@ -303,6 +323,9 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
                         backoff_base=args.farm_backoff_base,
                         chaos=args.chaos),
         aot=AotConfig(cache_dir=args.aot_cache, mode=args.aot),
+        recert=RecertConfig(dir=args.recert_dir,
+                            baseline_file=args.recert_baseline,
+                            require=args.require_recert),
     )
 
 

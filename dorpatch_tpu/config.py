@@ -261,6 +261,28 @@ class AotConfig:
     mode: str = "off"               # off|auto|strict
 
 
+@dataclasses.dataclass(frozen=True)
+class RecertConfig:
+    """Continuous re-certification (`dorpatch_tpu/recert/`): the serve-boot
+    robustness gate against the scheduler's published verdict.
+
+    `require` semantics (mirrors AotConfig.mode):
+      "off"    — (default) no gate; the snapshot is still loaded for
+                 `GET /robustness` when `dir` is set.
+      "warn"   — boot proceeds on any verdict (failing/stale/absent) and
+                 carries the degraded status in `/robustness` + the boot
+                 log — canary mode.
+      "strict" — the deploy mode: the pool refuses serving-ready
+                 (`RecertGateError`) unless the verdict exists and is
+                 `ok` — never serve silently-uncertified."""
+
+    dir: str = ""                   # recert dir holding recert_verdict.json
+                                    # ("" = no robustness surface)
+    baseline_file: str = ""         # baseline override ("" = the package's
+                                    # recert/robustness_baseline.json)
+    require: str = "off"            # off|warn|strict
+
+
 def config_to_dict(cfg: "ExperimentConfig") -> dict:
     """JSON-safe nested dict of the full experiment config (reproducibility
     record written beside summary.json by the pipelines)."""
@@ -289,9 +311,11 @@ def config_from_dict(d: dict) -> "ExperimentConfig":
     serve = build(ServeConfig, d.pop("serve", {}))
     farm = build(FarmConfig, d.pop("farm", {}))
     aot = build(AotConfig, d.pop("aot", {}))
+    recert = build(RecertConfig, d.pop("recert", {}))
     cfg = build(ExperimentConfig, d)
     return dataclasses.replace(cfg, attack=attack, defense=defense,
-                               serve=serve, farm=farm, aot=aot)
+                               serve=serve, farm=farm, aot=aot,
+                               recert=recert)
 
 
 def resolved_data_source(cfg: "ExperimentConfig") -> str:
@@ -371,6 +395,7 @@ class ExperimentConfig:
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     farm: FarmConfig = dataclasses.field(default_factory=FarmConfig)
     aot: AotConfig = dataclasses.field(default_factory=AotConfig)
+    recert: RecertConfig = dataclasses.field(default_factory=RecertConfig)
 
     @property
     def num_classes(self) -> int:

@@ -34,7 +34,8 @@ def is_farm_dir(path: str) -> bool:
     return os.path.exists(os.path.join(path, FARM_NAME))
 
 
-def _read_jsonl(path: str) -> List[dict]:
+def _read_jsonl(path: str, stats: Optional[Dict[str, int]] = None
+                ) -> List[dict]:
     out: List[dict] = []
     try:
         with open(path, errors="replace") as fh:
@@ -45,10 +46,26 @@ def _read_jsonl(path: str) -> List[dict]:
                 try:
                     out.append(json.loads(line))
                 except ValueError:
+                    if stats is not None:
+                        stats["torn"] = stats.get("torn", 0) + 1
                     continue
     except OSError:
         pass
     return out
+
+
+def read_result_rows(result_dir: str,
+                     stats: Optional[Dict[str, int]] = None) -> List[dict]:
+    """The rows a sweep actually recorded, hardened for live readers: the
+    recert scheduler and this fleet report read `rows.jsonl` while workers
+    append to it, so a torn final line, a half-flushed fragment, or a
+    parseable-but-non-dict JSON value must read as a missing cell (a
+    hole), never raise. `stats['torn']` counts what was skipped."""
+    rows = _read_jsonl(os.path.join(result_dir, "rows.jsonl"), stats=stats)
+    good = [r for r in rows if isinstance(r, dict)]
+    if stats is not None and len(good) != len(rows):
+        stats["torn"] = stats.get("torn", 0) + (len(rows) - len(good))
+    return good
 
 
 def _job_step_time(result_dir: str) -> Dict[str, float]:
@@ -110,7 +127,8 @@ def summarize_fleet(farm_dir: str) -> Optional[dict]:
         useful_s += step_time["useful_s"]
         wasted_s += step_time["wasted_s"]
         reexecuted_blocks += step_time["reexecuted_blocks"]
-        rows = _read_jsonl(os.path.join(result_dir, "rows.jsonl"))
+        row_stats: Dict[str, int] = {}
+        rows = read_result_rows(result_dir, stats=row_stats)
         for row in rows:
             point = {"job": job_id}
             point.update({k: row[k] for k in ROW_KEYS if k in row})
@@ -131,6 +149,7 @@ def summarize_fleet(farm_dir: str) -> Optional[dict]:
             "reclaims": int(job.get("reclaims", 0)),
             "run_ids": attempt_chain,
             "rows": len(rows),
+            "torn_rows": row_stats.get("torn", 0),
             "resumed_points": sum(
                 1 for r in rows if "resumed_from_iteration" in r),
             **step_time,
@@ -207,19 +226,29 @@ def format_fleet_report(s: dict) -> str:
             continue
         resumed = (f", {j['resumed_points']} resumed"
                    if j.get("resumed_points") else "")
+        torn = (f", {j['torn_rows']} torn"
+                if j.get("torn_rows") else "")
         add(f"  {j['id']:<28} {j['state']:<12} "
             f"attempts {j['attempts']}"
             f" ({len(j.get('run_ids', []))} run id(s))"
-            f", rows {j.get('rows', 0)}{resumed}")
-    if s["points"]:
+            f", rows {j.get('rows', 0)}{torn}{resumed}")
+    holes = [j for j in s["jobs"]
+             if j.get("torn_rows")
+             or (j.get("state") == "done" and not j.get("rows"))]
+    if s["points"] or holes:
         add("-- robust accuracy --")
         for p in s["points"]:
-            ra = p.get("robust_accuracy")
-            ca = p.get("certified_asr_pc")
+            ra = p.get("robust_accuracy", "?")
+            ca = p.get("certified_asr_pc", "?")
             resumed = (f"  [resumed @ {p['resumed_from_iteration']}]"
                        if "resumed_from_iteration" in p else "")
             add(f"  {p['job']:<28} budget {p.get('patch_budget', '?')} "
                 f"density {p.get('density', '?')} "
                 f"structured {p.get('structured', '?')}: "
                 f"robust acc {ra}%, certified ASR {ca}%{resumed}")
+        # a done job with torn or absent rows is a measurement HOLE, not a
+        # pass — render it explicitly so the grid never looks complete
+        for j in holes:
+            add(f"  {j['id']:<28} HOLE — {j.get('rows', 0)} recorded, "
+                f"{j.get('torn_rows', 0)} torn row(s)")
     return "\n".join(lines)

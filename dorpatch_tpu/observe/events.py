@@ -100,6 +100,7 @@ class EventLog:
         self._last_mark = perf()
         self._last_activity = perf()
         self._fh: Optional[IO[str]] = None
+        self._active_cms: List = []  # install-and-restore stack (__enter__)
         if path:
             try:
                 os.makedirs(os.path.dirname(os.path.abspath(path)),
@@ -200,9 +201,21 @@ class EventLog:
             self._fh = None
 
     def __enter__(self):
+        # entering the log installs it as the process's active sink (and
+        # exiting restores the previous one), so a bare
+        # `with EventLog(path) as el:` wires up the module-level
+        # span()/record_event() helpers. Historically __enter__ only
+        # returned self — telemetry silently went nowhere unless the caller
+        # also remembered `with observe.active(el):`, which remains legal
+        # but redundant.
+        cm = active(self)
+        cm.__enter__()
+        self._active_cms.append(cm)
         return self
 
     def __exit__(self, *exc):
+        if self._active_cms:
+            self._active_cms.pop().__exit__(None, None, None)
         self.close()
 
 

@@ -415,6 +415,55 @@ def _load_baseline_check(result_dir: str) -> Optional[dict]:
     return out if isinstance(out, dict) else None
 
 
+def _format_recert_report(st: dict, verdict: Optional[dict]) -> str:
+    """Human rendering of a re-certification dir: scheduler status plus the
+    latest generation's verdict (per-cell robust-accuracy vs baseline)."""
+    lines = []
+    add = lines.append
+    add("= DorPatch re-certification report =")
+    add(f"recert dir: {st['recert_dir']}")
+    add(f"baseline: {st['baseline_file']}")
+    add(f"completed generation: {st['generation']}")
+    infl = st.get("inflight")
+    if infl:
+        c = infl.get("counts") or {}
+        add(f"inflight: generation {infl['generation']} "
+            f"({c.get('done', 0)}/{c.get('total', 0)} jobs done, "
+            f"{c.get('failed_exhausted', 0)} exhausted, "
+            f"{c.get('quarantined', 0)} quarantined)")
+    if not verdict:
+        add("(no verdict yet — run `python -m dorpatch_tpu.recert run`)")
+        return "\n".join(lines)
+    add(f"-- verdict (generation {verdict.get('generation')}, "
+        f"baseline generation {verdict.get('baseline_generation')}) --")
+    wm = verdict.get("worst_margin")
+    add(f"  status: {verdict.get('status', '?')}"
+        + (f", worst margin {wm:+.2f} pts above the tolerance floor"
+           if wm is not None else "")
+        + ("" if verdict.get("seeded") else " (baseline UNSEEDED)"))
+    by_rule = verdict.get("findings_by_rule") or {}
+    if by_rule:
+        add("  findings: "
+            + ", ".join(f"{k}: {v}" for k, v in sorted(by_rule.items())))
+    cells = verdict.get("cells") or {}
+    if cells:
+        add(f"-- cells ({len(cells)}) --")
+    for key, c in sorted(cells.items()):
+        parts = []
+        if "measured" in c:
+            parts.append(f"measured {c['measured']:.2f}")
+        if "baseline" in c:
+            parts.append(f"baseline {c['baseline']:.2f} "
+                         f"(tol {c.get('tolerance', '?')})")
+        if "margin" in c:
+            parts.append(f"margin {c['margin']:+.2f}")
+        flag = str(c.get("status", "?"))
+        add(f"  [{flag:>9}] {key}: " + ", ".join(parts or ["no data"]))
+    for f in (verdict.get("findings") or [])[:8]:
+        add(f"  {f.get('rule', '?')} {f.get('message', '')[:110]}")
+    return "\n".join(lines)
+
+
 def _fmt_bytes(n: int) -> str:
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
         if n < 1024 or unit == "TiB":
@@ -621,6 +670,25 @@ def main(argv=None) -> int:
     if not os.path.isdir(args.result_dir):
         print(f"not a directory: {args.result_dir}")
         return 2
+    # a recert dir (marked by recert_state.json) gets the re-certification
+    # report: scheduler status + the latest verdict; lazy, host-only import
+    if os.path.exists(os.path.join(args.result_dir, "recert_state.json")):
+        from dorpatch_tpu.checkpoint import load_json
+        from dorpatch_tpu.recert.scheduler import RecertScheduler
+
+        sched = RecertScheduler(args.result_dir)
+        st = sched.status()
+        verdict = load_json(sched.verdict_path)
+        try:
+            if args.json:
+                print(json.dumps({"status": st, "verdict": verdict},
+                                 indent=1, default=float))
+            else:
+                print(_format_recert_report(
+                    st, verdict if isinstance(verdict, dict) else None))
+        except BrokenPipeError:
+            return 0
+        return 0
     # a farm dir (marked by farm.json) gets the fleet-level report; the
     # import is lazy and farm.report is host-only, same contract as here
     farm_marker = os.path.join(args.result_dir, "farm.json")

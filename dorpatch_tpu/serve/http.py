@@ -9,6 +9,10 @@ Endpoints:
 - `GET /healthz` — liveness + warmup state.
 - `GET /stats`   — the service's live counters, latency percentiles,
   queue depth, and per-program trace counts.
+- `GET /robustness` — the recert verdict snapshot loaded at boot
+  (gate mode, per-cell status, generation, worst margin); status 200
+  when the verdict is `ok`, 503 when failing/stale/absent so a canary
+  gate can probe it like a health check.
 
 One handler thread per connection (`ThreadingHTTPServer`); every thread
 funnels into the same `service.predict`, so the micro-batcher — not the
@@ -45,6 +49,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200 if h["status"] == "ok" else 503, h)
         elif self.path == "/stats":
             self._send_json(200, self.service.stats())
+        elif self.path == "/robustness":
+            r = self.service.robustness()
+            # canary-probe contract: 200 only on a clean verdict, 503 on
+            # failing/stale/absent/unconfigured — a deploy gate can treat
+            # this exactly like /healthz
+            self._send_json(200 if r.get("status") == "ok" else 503, r)
         else:
             self._send_json(404, {"status": "error",
                                   "reason": f"no route {self.path}"})
@@ -94,7 +104,7 @@ class HttpFrontend:
                                         name="serve-http", daemon=True)
         self._thread.start()
         observe.log(f"serve: http front-end on {self.host}:{self.port} "
-                    f"(/predict /healthz /stats)")
+                    f"(/predict /healthz /stats /robustness)")
         return self
 
     def stop(self) -> None:

@@ -135,6 +135,7 @@ class CertifiedInferenceService:
         clock=time.perf_counter,
         incremental_engine: Any = None,
         aot_cfg: Any = None,
+        recert_cfg: Any = None,
     ):
         self.apply_fn = apply_fn
         self.params = params
@@ -150,6 +151,10 @@ class CertifiedInferenceService:
         # executable store instead of tracing — see _start_inner
         self.aot_cfg = aot_cfg
         self._aot_stats: Optional[Dict[str, Any]] = None
+        # RecertConfig (or None): the robustness boot gate + the snapshot
+        # behind `GET /robustness` — see _start_inner
+        self.recert_cfg = recert_cfg
+        self._robustness: Optional[Dict[str, Any]] = None
 
         self.bucket_sizes = tuple(resolved_bucket_sizes(serve_cfg))
         n_buckets = len(self.bucket_sizes)
@@ -224,7 +229,8 @@ class CertifiedInferenceService:
                    result_dir=result_dir if cfg.metrics_log else None,
                    run_cfg=cfg,
                    incremental_engine=victim.incremental,
-                   aot_cfg=getattr(cfg, "aot", None))
+                   aot_cfg=getattr(cfg, "aot", None),
+                   recert_cfg=getattr(cfg, "recert", None))
 
     # ---------------- lifecycle ----------------
 
@@ -269,6 +275,26 @@ class CertifiedInferenceService:
             # phase, and open-span accounting all hang off this span (a
             # crashed service leaves it open — the hang signature)
             self._stack.enter_context(observe.span("run", service="serve"))
+        if self.recert_cfg is not None and (
+                getattr(self.recert_cfg, "require", "off") != "off"
+                or getattr(self.recert_cfg, "dir", "")):
+            # robustness boot gate, deliberately BEFORE any compile work:
+            # under `--require-recert strict` a failing/stale/absent recert
+            # verdict refuses serving-ready here with a typed
+            # RecertGateError (mirroring AOT strict boot); `warn` records
+            # the degraded status and serves, `GET /robustness` renders it
+            from dorpatch_tpu.recert.gate import boot_gate
+
+            self._robustness = boot_gate(
+                getattr(self.recert_cfg, "dir", ""),
+                getattr(self.recert_cfg, "require", "off"))
+            if self._robustness is not None:
+                observe.record_event(
+                    "serve.recert_gate",
+                    require=self._robustness["require"],
+                    status=self._robustness["status"],
+                    generation=self._robustness.get("generation"),
+                    worst_margin=self._robustness.get("worst_margin"))
         if self.enforce_budgets:
             # arm the PR 2 recompile watchdog for the serving process: any
             # program re-tracing past its per-bucket budget fails the batch
@@ -617,6 +643,16 @@ class CertifiedInferenceService:
                                if r.state == "retired")}
         return out
 
+    def robustness(self) -> dict:
+        """The recert verdict snapshot loaded at boot (`GET /robustness`):
+        gate mode, verdict status, generation, worst margin, per-cell
+        grid. Reflects the verdict AS OF BOOT — the gate is a boot gate
+        (mirroring AOT strict boot), so a fresh generation's verdict takes
+        effect at the next restart."""
+        if self._robustness is None:
+            return {"require": "off", "status": "unconfigured"}
+        return dict(self._robustness)
+
     def stats(self) -> dict:
         s = self._snapshot()
         s["queue_depth"] = self.batcher.qsize()
@@ -625,6 +661,10 @@ class CertifiedInferenceService:
         s["warm"] = self._warm
         if self._aot_stats is not None:
             s["aot"] = self._aot_stats
+        if self._robustness is not None:
+            s["robustness"] = {
+                k: self._robustness.get(k)
+                for k in ("require", "status", "generation", "worst_margin")}
         if self._started_at is not None:
             s["uptime_s"] = round(self._clock() - self._started_at, 3)
         pool = self._pool

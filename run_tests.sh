@@ -143,3 +143,18 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   | grep -q '"ok": true' \
   || { echo "serve chaos smoke: failover/restart violation"; exit 1; }
 echo "serve chaos smoke: OK"
+# Smoke: the continuous re-certification platform — a control scheduler runs
+# one full 2x2 (patch_budget x density) generation through real farm
+# workers; a chaos scheduler is SIGKILLed mid-generation with a torn
+# recert_state.json and its resume must complete the SAME generation with a
+# baseline byte-identical to the control's; a planted regression must make
+# `recert check` exit 1 naming the cell (DP400); serve must refuse
+# serving-ready under --require-recert strict (typed RecertGateError,
+# before any compile) while warn boots with the armed watchdog and
+# GET /robustness answers 503 rendering the regressed cell
+# (tools/recert_smoke.py exits non-zero and lists the violations otherwise).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/recert_smoke.py \
+  | grep -q '"ok": true' \
+  || { echo "recert smoke: re-certification/gate violation"; exit 1; }
+echo "recert smoke: OK"

@@ -86,3 +86,10 @@ def regressed_entrypoints():
     """--entrypoints loader: same names, one planted +~100% FLOPs
     regression (DP301) and one donation flip (DP304)."""
     return [regressed_entrypoint(), carry_donated_entrypoint()]
+
+
+def shrunk_entrypoints():
+    """--entrypoints loader: a strict subset of `clean_entrypoints` — the
+    shape of a single-device regeneration that silently loses the mesh
+    tier's entries (the `--allow-remove` guard's target)."""
+    return [ref_entrypoint()]

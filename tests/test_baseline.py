@@ -257,6 +257,36 @@ def test_update_idempotent_byte_identical(tmp_path):
     assert data["version"] == 1
 
 
+def test_update_refuses_silent_entry_removal(tmp_path, capsys):
+    """A regeneration that would DROP baselined entry points (the classic
+    cause: running without the 8-device virtual mesh, losing every .mesh
+    entry) must refuse and leave the file untouched unless --allow-remove
+    says the shrink is intentional."""
+    path = tmp_path / "baselines.json"
+    assert cli_main(["--baseline", "update", "--baseline-file", str(path),
+                     "--baseline-cost", "estimate",
+                     "--entrypoints",
+                     "baseline_programs:clean_entrypoints"]) == 0
+    before = path.read_bytes()
+    capsys.readouterr()
+
+    rc = cli_main(["--baseline", "update", "--baseline-file", str(path),
+                   "--baseline-cost", "estimate",
+                   "--entrypoints", "baseline_programs:shrunk_entrypoints"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "fx.base.carry" in err and "--allow-remove" in err
+    assert "NOT written" in err
+    assert path.read_bytes() == before, "refusal must leave the file alone"
+
+    assert cli_main(["--baseline", "update", "--baseline-file", str(path),
+                     "--baseline-cost", "estimate", "--allow-remove",
+                     "--entrypoints",
+                     "baseline_programs:shrunk_entrypoints"]) == 0
+    data = json.loads(path.read_bytes())
+    assert sorted(data["entries"]) == ["fx.base.ref"]
+
+
 def test_update_refuses_untraceable(tmp_path):
     import trace_programs
 

@@ -187,6 +187,46 @@ def test_stale_lease_without_heartbeat_uses_expires_ts(tmp_path):
     assert reclaimed is not None and reclaimed["worker"] == "wB"
 
 
+def test_lease_skew_stale_heartbeat_beats_future_expires_ts(tmp_path):
+    """Clock skew: a renewal stamped by a skewed clock pushes `expires_ts`
+    far into the future while the worker's heartbeat — its actual liveness
+    witness — has stopped. The heartbeat must win: the job is reclaimable
+    even though the fallback says the lease is alive."""
+    clock = FakeClock(1000.0)
+    jq = JobQueue(str(tmp_path / "farm"), clock=clock)
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 3})
+    hb = tmp_path / "hb.jsonl"
+    hb.write_text(json.dumps({"ts": 1000.0, "seq": 0, "phase": "x"}) + "\n")
+    assert jq.claim("wA", ttl=10.0, heartbeat_path=str(hb)) is not None
+    assert jq.renew_lease(job_id, "wA", 10_000.0)  # expires_ts ~ 11000
+    clock.now = 1020.0  # beats stopped at ts=1000: 20s > TTL
+    reclaimed = jq.claim("wB", ttl=10.0)
+    assert reclaimed is not None and reclaimed["worker"] == "wB"
+
+
+def test_lease_skew_future_heartbeat_beats_lapsed_expires_ts(tmp_path):
+    """Clock skew the other way: the worker's clock runs ahead, so its beat
+    timestamps sit in the queue clock's future while the queue-stamped
+    `expires_ts` lapses. A worker that is demonstrably still beating must
+    not lose its lease to the fallback disagreeing."""
+    clock = FakeClock(1000.0)
+    jq = JobQueue(str(tmp_path / "farm"), clock=clock)
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 3})
+    hb = tmp_path / "hb.jsonl"
+    hb.write_text(json.dumps({"ts": 1000.0, "seq": 0, "phase": "x"}) + "\n")
+    assert jq.claim("wA", ttl=10.0, heartbeat_path=str(hb)) is not None
+    # expires_ts=1010 has lapsed, but the skewed-ahead worker beat at
+    # ts=1018 — (now - ts) <= ttl stays true, the lease holds
+    clock.now = 1015.0
+    hb.write_text(hb.read_text()
+                  + json.dumps({"ts": 1018.0, "seq": 1, "phase": "x"}) + "\n")
+    assert jq.claim("wB", ttl=10.0) is None
+    # once the worker truly stops, staleness follows the heartbeat
+    clock.now = 1040.0
+    reclaimed = jq.claim("wB", ttl=10.0)
+    assert reclaimed is not None and reclaimed["id"] == job_id
+
+
 def test_corrupt_lease_is_reclaimable(tmp_path):
     jq = JobQueue(str(tmp_path / "farm"))
     (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 1})
@@ -521,6 +561,29 @@ def test_format_fleet_report_sections(tmp_path):
     assert "-- farm --" in text and "-- jobs --" in text
     assert "-- robust accuracy --" in text
     assert "quarantined" in text and "robust acc 50.0%" in text
+
+
+def test_fleet_report_torn_rows_render_as_holes(tmp_path):
+    """Torn / partial `rows.jsonl` files (a worker killed mid-append, a
+    truncated copy) must degrade to explicit HOLE lines in the report, not
+    a parse error — and a done job whose rows vanished entirely is a hole
+    too."""
+    farm, jq = _run_fleet(tmp_path)
+    done = [j for j in jq.job_ids() if jq.read_job(j)["state"] == "done"]
+    torn_dir = os.path.join(jq.job_dir(done[0]), "results")
+    with open(os.path.join(torn_dir, "rows.jsonl"), "a") as fh:
+        fh.write('{"patch_budget": 0.06, "robust_acc')  # killed mid-append
+    missing_dir = os.path.join(jq.job_dir(done[1]), "results")
+    os.remove(os.path.join(missing_dir, "rows.jsonl"))
+
+    fleet = summarize_fleet(farm)  # must not raise
+    by_id = {j["id"]: j for j in fleet["jobs"]}
+    assert by_id[done[0]]["torn_rows"] == 1
+    assert by_id[done[1]]["rows"] == 0
+
+    text = format_fleet_report(fleet)
+    assert "1 torn" in text
+    assert "HOLE" in text and done[1] in text
 
 
 def test_report_cli_dispatches_on_farm_dir(tmp_path, capsys):

@@ -169,6 +169,34 @@ def test_module_span_noops_without_active_log(tmp_path):
     assert kinds == ["begin", "span", "event", "compile"]
 
 
+def test_event_log_enter_installs_and_restores_active(tmp_path):
+    """`with EventLog(...) as el:` alone must wire up the module-level
+    helpers — the historical footgun was an __enter__ that only returned
+    self, so telemetry emitted through observe.span/record_event silently
+    went nowhere unless the caller also remembered observe.active(el)."""
+    assert observe.active_event_log() is None
+    elog = observe.EventLog(str(tmp_path / "e.jsonl"), run_id="r")
+    with elog:
+        assert observe.active_event_log() is elog
+        observe.record_event("solo", k=1)
+        # the old belt-and-braces pattern stays legal (and redundant)
+        with observe.active(elog):
+            assert observe.active_event_log() is elog
+        assert observe.active_event_log() is elog
+    assert observe.active_event_log() is None
+    kinds = [json.loads(l)["kind"] for l in open(str(tmp_path / "e.jsonl"))]
+    assert kinds == ["event"]
+
+    # nesting two logs restores the OUTER one on inner exit
+    outer = observe.EventLog(None)
+    inner = observe.EventLog(None)
+    with outer:
+        with inner:
+            assert observe.active_event_log() is inner
+        assert observe.active_event_log() is outer
+    assert observe.active_event_log() is None
+
+
 def test_timed_first_call_records_compile_once(tmp_path):
     calls = []
     clock = iter([10.0, 12.5, 20.0, 20.1]).__next__

@@ -1,0 +1,461 @@
+"""Continuous re-certification: baseline diff rules (DP400-DP402),
+crash-resumable scheduler generations, serve boot gate, CLI contract.
+
+Fast tests drive the scheduler with stub farm runners (no model build); the
+full pipeline + SIGKILL resume is `tools/recert_smoke.py`'s job.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dorpatch_tpu.config import RecertConfig
+from dorpatch_tpu.farm.queue import JobQueue
+from dorpatch_tpu.farm.worker import FarmWorker
+from dorpatch_tpu.recert import baseline as rb
+from dorpatch_tpu.recert.__main__ import main as recert_main
+from dorpatch_tpu.recert.gate import RecertGateError, boot_gate, snapshot
+from dorpatch_tpu.recert.scheduler import (
+    RecertError, RecertScheduler, is_recert_dir)
+from dorpatch_tpu.sweep import append_row
+
+SPEC = {
+    "base": {"dataset": "cifar10", "base_arch": "resnet18", "img_size": 32,
+             "batch_size": 2, "synthetic_data": True},
+    "axes": {"attack.patch_budget": [0.06, 0.12]},
+    "sweep": {"densities": [0.0], "structureds": [1e-3],
+              "defense_ratio": 0.06},
+    "max_attempts": 2,
+}
+
+JOB = {"base": SPEC["base"], "sweep": SPEC["sweep"],
+       "params": {"attack.patch_budget": 0.06}}
+
+
+def stub_runner(ra=50.0, asr=25.0):
+    """A farm runner writing one plausible sweep row per job."""
+    def runner(job, ctx):
+        append_row(ctx.result_dir, {
+            "patch_budget": job["params"]["attack.patch_budget"],
+            "density": 0.0, "structured": 1e-3,
+            "robust_accuracy": ra, "certified_asr_pc": asr,
+            "asr": 100.0 - ra, "point": 0, "images": 2})
+        return {"rows": 1}
+    return runner
+
+
+def _drain(farm_dir, runner, worker_id="w"):
+    FarmWorker(str(farm_dir), worker_id=worker_id, lease_ttl=10.0,
+               poll_interval=0.02, heartbeat_interval=0.2,
+               backoff_base=0.05, backoff_cap=0.2, runner=runner).run()
+
+
+def _cycle(sched, spec=None, ra=50.0, update=False, runner=None):
+    gen, farm_dir = sched.begin_generation(spec)
+    _drain(farm_dir, runner or stub_runner(ra=ra))
+    return gen, sched.complete_generation(gen, farm_dir,
+                                          update_baseline=update)
+
+
+# ---------------- cell keys / measurements ----------------
+
+
+def test_cell_key_json_roundtrip_stable():
+    # a spec float and the same float recorded through rows.jsonl must
+    # produce the same key, or every generation would look like grid drift
+    row = {"patch_budget": 0.06, "density": 0.0, "structured": 1e-3}
+    recorded = json.loads(json.dumps(row))
+    assert rb.cell_key(JOB, row) == rb.cell_key(JOB, recorded)
+    key = rb.cell_key(JOB, row)
+    assert key.startswith("resnet18@cifar10/32|pc:r0.06|")
+    assert "patch_budget=0.06" in key
+
+
+def test_cell_key_carries_non_grid_axis_params():
+    a = dict(JOB, params={"attack.patch_budget": 0.06, "attack.dropout": 1})
+    b = dict(JOB, params={"attack.patch_budget": 0.06, "attack.dropout": 2})
+    row = {"patch_budget": 0.06, "density": 0.0, "structured": 1e-3}
+    assert rb.cell_key(a, row) != rb.cell_key(b, row)
+
+
+def test_job_cells_enumerable_without_rows():
+    job = {**JOB, "sweep": {**SPEC["sweep"], "patch_budgets": [0.06, 0.12]}}
+    cells = rb.job_cells(job)
+    assert len(cells) == 2 and len(set(cells)) == 2
+
+
+def test_fold_and_dump_deterministic():
+    measured = {"k1": {"robust_accuracy": 51.5, "certified_asr_pc": 20.0,
+                       "images": 4, "job": "j"}}
+    d1 = rb.fold_measurements(None, measured, 3)
+    d2 = rb.fold_measurements(rb.empty_baseline(), dict(measured), 3)
+    assert rb.dump_baseline(d1) == rb.dump_baseline(d2)
+    assert d1["entries"]["k1"]["generation"] == 3
+    assert d1["generation"] == 3
+    # folding on top preserves unmeasured entries and per-cell overrides
+    d1["entries"]["k2"] = {"robust_accuracy": 70.0, "certified_asr_pc": 5.0,
+                           "tolerance": 5.0}
+    d3 = rb.fold_measurements(d1, measured, 4)
+    assert d3["entries"]["k2"]["robust_accuracy"] == 70.0
+    assert d3["entries"]["k1"]["generation"] == 4
+
+
+def _seeded(ra=50.0, asr=25.0, tol=None):
+    entry = {"robust_accuracy": ra, "certified_asr_pc": asr, "images": 2,
+             "generation": 1}
+    if tol is not None:
+        entry["tolerance"] = tol
+    return {"version": 1, "generation": 1, "tolerance_default": 2.0,
+            "entries": {"cellA": entry}}
+
+
+def _m(ra=50.0, asr=25.0):
+    return {"robust_accuracy": ra, "certified_asr_pc": asr, "images": 2,
+            "job": "j"}
+
+
+def test_check_unseeded_baseline_is_dp402():
+    fs = rb.check_measurements({"cellA": _m()}, [], None, 1)
+    # ...and the fresh cell also reads as DP401 added vs the empty set
+    assert {f.rule_id for f in fs} == {"DP401", "DP402"}
+    unseeded = [f for f in fs if f.rule_id == "DP402"]
+    assert len(unseeded) == 1 and "<unseeded>" in unseeded[0].message
+
+
+def test_check_regression_and_asr_rules():
+    data = _seeded(ra=50.0, asr=25.0)
+    assert rb.check_measurements({"cellA": _m(ra=48.5)}, [], data, 2) == []
+    fs = rb.check_measurements({"cellA": _m(ra=47.0)}, [], data, 2)
+    assert [f.rule_id for f in fs] == ["DP400"]
+    assert "50.00% -> 47.00%" in fs[0].message
+    # robust accuracy inside tolerance, certified ASR eroding past it
+    fs = rb.check_measurements({"cellA": _m(ra=50.0, asr=28.0)}, [], data, 2)
+    assert [f.rule_id for f in fs] == ["DP400"]
+    assert "certified attack success rose" in fs[0].message
+
+
+def test_check_per_cell_tolerance_overrides_default():
+    data = _seeded(ra=50.0, tol=10.0)
+    assert rb.check_measurements({"cellA": _m(ra=42.0)}, [], data, 2) == []
+    fs = rb.check_measurements({"cellA": _m(ra=39.0)}, [], data, 2)
+    assert [f.rule_id for f in fs] == ["DP400"]
+
+
+def test_check_grid_drift_and_holes():
+    data = _seeded()
+    fs = rb.check_measurements({"cellA": _m(), "cellB": _m()}, [], data, 2)
+    assert [(f.rule_id, "cellB" in f.message) for f in fs] == [("DP401", True)]
+    fs = rb.check_measurements({}, [], data, 2)  # cellA gone from the grid
+    assert [f.rule_id for f in fs] == ["DP401"]
+    assert "--allow-remove" in fs[0].message
+    fs = rb.check_measurements({}, ["cellA"], data, 5)  # covered, unmeasured
+    assert [f.rule_id for f in fs] == ["DP402"]
+    assert "4 generation(s) old" in fs[0].message
+
+
+def test_check_allowlist_and_select():
+    data = _seeded()
+    measured = {"cellA": _m(ra=40.0), "cellB": _m()}
+    fs = rb.check_measurements(measured, [], data, 2)
+    assert {f.rule_id for f in fs} == {"DP400", "DP401"}
+    allow = {"cellA": {"DP400": "known noisy cell"}}
+    fs = rb.check_measurements(measured, [], data, 2, allow=allow)
+    assert [f.rule_id for f in fs] == ["DP401"]
+    fs = rb.check_measurements(measured, [], data, 2, select=["DP400"])
+    assert [f.rule_id for f in fs] == ["DP400"]
+
+
+def test_build_verdict_statuses_and_margin():
+    data = _seeded(ra=50.0)
+    fs = rb.check_measurements({"cellA": _m(ra=47.0)}, [], data, 2)
+    v = rb.build_verdict({"cellA": _m(ra=47.0)}, [], data, 2, fs)
+    assert v["status"] == "failing"
+    assert v["cells"]["cellA"]["status"] == "regressed"
+    assert v["worst_margin"] == pytest.approx(-1.0)
+    v = rb.build_verdict({"cellA": _m(ra=49.0)}, [], data, 2, [])
+    assert v["status"] == "ok" and v["worst_margin"] == pytest.approx(1.0)
+    fs = rb.check_measurements({}, ["cellA"], data, 2)
+    v = rb.build_verdict({}, ["cellA"], data, 2, fs)
+    assert v["status"] == "stale" and v["cells"]["cellA"]["status"] == "stale"
+
+
+# ---------------- scheduler generations ----------------
+
+
+def test_scheduler_full_cycle_seeds_then_stays_ok(tmp_path):
+    sched = RecertScheduler(str(tmp_path / "recert"),
+                            baseline_file=str(tmp_path / "rb.json"))
+    gen, verdict = _cycle(sched, SPEC, update=True)
+    assert gen == 1 and verdict["status"] == "ok"
+    assert len(verdict["cells"]) == 2
+    assert is_recert_dir(str(tmp_path / "recert"))
+    # second generation, same numbers, no update: clean against the seed
+    gen, verdict = _cycle(sched, SPEC)
+    assert gen == 2 and verdict["status"] == "ok" and verdict["clean"]
+    assert verdict["worst_margin"] == pytest.approx(2.0)
+
+
+def test_scheduler_resumes_inflight_generation_not_resubmit(tmp_path):
+    sched = RecertScheduler(str(tmp_path / "recert"),
+                            baseline_file=str(tmp_path / "rb.json"))
+    gen, farm_dir = sched.begin_generation(SPEC)
+    # crash before completion: a new scheduler instance (fresh process)
+    # must resume THIS generation — spec comes from the inflight record
+    sched2 = RecertScheduler(str(tmp_path / "recert"),
+                             baseline_file=str(tmp_path / "rb.json"))
+    gen2, farm_dir2 = sched2.begin_generation()
+    assert (gen2, farm_dir2) == (gen, farm_dir)
+    assert JobQueue(farm_dir2).counts()["total"] == 2
+    _drain(farm_dir2, stub_runner())
+    verdict = sched2.complete_generation(gen2, farm_dir2,
+                                         update_baseline=True)
+    assert verdict["generation"] == gen
+    # after completion a begin without a spec has nothing to run
+    with pytest.raises(RecertError):
+        sched2.begin_generation()
+
+
+def test_scheduler_recovers_from_torn_state_file(tmp_path):
+    sched = RecertScheduler(str(tmp_path / "recert"),
+                            baseline_file=str(tmp_path / "rb.json"))
+    _cycle(sched, SPEC, update=True)
+    gen, farm_dir = sched.begin_generation(SPEC)
+    state_path = sched.state_path
+    raw = open(state_path, "rb").read()
+    with open(state_path, "wb") as fh:  # torn mid-write by a crash
+        fh.write(raw[:len(raw) // 2])
+    sched3 = RecertScheduler(str(tmp_path / "recert"),
+                             baseline_file=str(tmp_path / "rb.json"))
+    st = sched3.load_state()
+    assert st["generation"] == 1  # healed from the gen dirs on disk
+    assert st["inflight"]["generation"] == gen
+    gen3, farm_dir3 = sched3.begin_generation()
+    assert (gen3, farm_dir3) == (gen, farm_dir)
+
+
+def test_quarantined_job_becomes_hole_generation_completes(tmp_path):
+    sched = RecertScheduler(str(tmp_path / "recert"),
+                            baseline_file=str(tmp_path / "rb.json"))
+    _cycle(sched, SPEC, update=True)
+
+    def half_bad(job, ctx):
+        if job["params"]["attack.patch_budget"] == 0.12:
+            raise ValueError("deterministic failure -> quarantine")
+        return stub_runner()(job, ctx)
+
+    gen, farm_dir = sched.begin_generation(SPEC)
+    _drain(farm_dir, half_bad)
+    assert sched.drained(farm_dir)  # quarantine never hangs the generation
+    verdict = sched.complete_generation(gen, farm_dir)
+    assert verdict["status"] == "stale"
+    assert verdict["findings_by_rule"] == {"DP402": 1}
+    stale = [k for k, c in verdict["cells"].items()
+             if c["status"] == "stale"]
+    assert len(stale) == 1 and "patch_budget=0.12" in stale[0]
+
+
+def test_update_from_latest_refuses_shrink_without_allow_remove(tmp_path):
+    sched = RecertScheduler(str(tmp_path / "recert"),
+                            baseline_file=str(tmp_path / "rb.json"))
+    _cycle(sched, SPEC, update=True)
+    shrunk = dict(SPEC, axes={"attack.patch_budget": [0.06]})
+    _cycle(sched, shrunk)
+    before = open(sched.baseline_file, "rb").read()
+    with pytest.raises(RecertError, match="--allow-remove"):
+        sched.update_from_latest()
+    assert open(sched.baseline_file, "rb").read() == before
+    summary = sched.update_from_latest(allow_remove=True)
+    assert len(summary["removed"]) == 1
+    data = rb.load_baseline(sched.baseline_file)
+    assert len(data["entries"]) == 1
+
+
+def test_update_keeps_hole_cells(tmp_path):
+    # a hole is a missing measurement, not a grid change: update must not
+    # silently drop the cell's reference entry
+    sched = RecertScheduler(str(tmp_path / "recert"),
+                            baseline_file=str(tmp_path / "rb.json"))
+    _cycle(sched, SPEC, update=True)
+
+    def half_bad(job, ctx):
+        if job["params"]["attack.patch_budget"] == 0.12:
+            raise ValueError("boom")
+        return stub_runner()(job, ctx)
+
+    gen, farm_dir = sched.begin_generation(SPEC)
+    _drain(farm_dir, half_bad)
+    sched.complete_generation(gen, farm_dir)
+    summary = sched.update_from_latest()  # no removal: holes are kept
+    assert summary["removed"] == []
+    data = rb.load_baseline(sched.baseline_file)
+    assert len(data["entries"]) == 2
+
+
+# ---------------- serve boot gate ----------------
+
+
+def test_boot_gate_modes(tmp_path):
+    assert boot_gate("", "off") is None
+    with pytest.raises(ValueError):
+        boot_gate("", "paranoid")
+    with pytest.raises(RecertGateError):
+        boot_gate("", "strict")  # a mode that reads a verdict needs a dir
+    # absent verdict: warn degrades, strict refuses
+    snap = boot_gate(str(tmp_path), "warn")
+    assert snap["status"] == "absent"
+    with pytest.raises(RecertGateError, match="absent"):
+        boot_gate(str(tmp_path), "strict")
+
+
+def test_boot_gate_reads_published_verdict(tmp_path):
+    sched = RecertScheduler(str(tmp_path / "recert"),
+                            baseline_file=str(tmp_path / "rb.json"))
+    _cycle(sched, SPEC, update=True)
+    snap = boot_gate(str(tmp_path / "recert"), "strict")
+    assert snap["status"] == "ok" and snap["generation"] == 1
+    # plant a regression: strict refuses naming the cell, warn carries it
+    _cycle(sched, SPEC, ra=40.0)
+    with pytest.raises(RecertGateError, match="patch_budget"):
+        boot_gate(str(tmp_path / "recert"), "strict")
+    snap = boot_gate(str(tmp_path / "recert"), "warn")
+    assert snap["status"] == "failing"
+    assert snap["findings_by_rule"] == {"DP400": 2}
+    assert snapshot(str(tmp_path / "recert"))["status"] == "failing"
+
+
+def test_service_boot_gate_strict_refuses_warn_serves(tmp_path):
+    from dorpatch_tpu.config import DefenseConfig, ServeConfig
+    from dorpatch_tpu.serve.service import CertifiedInferenceService
+
+    sched = RecertScheduler(str(tmp_path / "recert"),
+                            baseline_file=str(tmp_path / "rb.json"))
+    _cycle(sched, SPEC, update=True)
+    _cycle(sched, SPEC, ra=40.0)  # published verdict now failing
+
+    def stub_apply(params, x):
+        s = x.mean(axis=(1, 2, 3))
+        return jax.nn.one_hot((s * 7).astype(jnp.int32) % 5, 5)
+
+    def make(require):
+        return CertifiedInferenceService(
+            stub_apply, None, num_classes=5, img_size=32,
+            serve_cfg=ServeConfig(max_batch=2, bucket_sizes=(1, 2),
+                                  replicas=1),
+            defense_cfg=DefenseConfig(ratios=(0.1,), chunk_size=64),
+            recert_cfg=RecertConfig(dir=str(tmp_path / "recert"),
+                                    require=require))
+
+    with pytest.raises(RecertGateError, match="failing"):
+        make("strict").start()
+    svc = make("warn").start()
+    try:
+        r = svc.robustness()
+        assert r["status"] == "failing" and r["require"] == "warn"
+        assert any(c.get("status") == "regressed"
+                   for c in r["cells"].values())
+        assert svc.stats()["robustness"]["status"] == "failing"
+        resp = svc.predict(np.zeros((32, 32, 3), np.float32))
+        assert resp.status == "ok"  # warn mode serves, loudly degraded
+    finally:
+        svc.stop()
+
+
+def test_service_robustness_unconfigured():
+    from dorpatch_tpu.serve.service import CertifiedInferenceService
+    svc = CertifiedInferenceService.__new__(CertifiedInferenceService)
+    svc._robustness = None
+    assert svc.robustness() == {"require": "off", "status": "unconfigured"}
+
+
+# ---------------- CLI contract ----------------
+
+
+def test_cli_schedule_status_check_roundtrip(tmp_path, capsys):
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(SPEC))
+    rdir = str(tmp_path / "recert")
+    bfile = str(tmp_path / "rb.json")
+    assert recert_main(["schedule", rdir, "--spec", str(spec_path),
+                        "--baseline-file", bfile]) == 0
+    capsys.readouterr()
+    assert recert_main(["status", rdir, "--baseline-file", bfile]) == 0
+    out = capsys.readouterr().out  # observe.log prefixes "[pN +T.Ts] "
+    st = json.loads(out[out.index("{"):])
+    assert st["inflight"]["generation"] == 1
+    assert st["inflight"]["counts"]["total"] == 2
+
+    # drain out-of-band (the CLI's in-process worker runs the real model
+    # stack; unit tests use the stub runner), then check via the CLI
+    sched = RecertScheduler(rdir, baseline_file=bfile)
+    gen, farm_dir = sched.begin_generation()
+    _drain(farm_dir, stub_runner())
+    sched.complete_generation(gen, farm_dir, update_baseline=True)
+
+    assert recert_main(["check", rdir, "--baseline-file", bfile]) == 0
+    capsys.readouterr()
+
+    # plant a regression generation: check exits 1 naming the cell
+    gen, farm_dir = sched.begin_generation(SPEC)
+    _drain(farm_dir, stub_runner(ra=40.0))
+    sched.complete_generation(gen, farm_dir)
+    capsys.readouterr()  # drop the out-of-band worker's log lines
+    rc = recert_main(["check", rdir, "--baseline-file", bfile,
+                      "--format", "json"])
+    assert rc == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    objs = [json.loads(line) for line in out]
+    assert {o["rule"] for o in objs} == {"DP400"}
+    assert all("patch_budget" in o["message"] for o in objs)
+
+    # select filter validates rule ids (usage error -> 2)
+    assert recert_main(["check", rdir, "--baseline-file", bfile,
+                        "--select", "DP999"]) == 2
+
+
+def test_cli_check_without_generation_is_usage_error(tmp_path):
+    assert recert_main(["check", str(tmp_path / "empty")]) == 2
+    assert recert_main(["run", str(tmp_path / "empty2")]) == 2  # no spec
+
+
+def test_cli_update_refusal_exit_code(tmp_path, capsys):
+    rdir = str(tmp_path / "recert")
+    bfile = str(tmp_path / "rb.json")
+    sched = RecertScheduler(rdir, baseline_file=bfile)
+    _cycle(sched, SPEC, update=True)
+    _cycle(sched, dict(SPEC, axes={"attack.patch_budget": [0.06]}))
+    assert recert_main(["update", rdir, "--baseline-file", bfile]) == 1
+    assert "--allow-remove" in capsys.readouterr().err
+    assert recert_main(["update", rdir, "--baseline-file", bfile,
+                        "--allow-remove"]) == 0
+
+
+def test_cli_list_rules(capsys):
+    assert recert_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("DP400", "DP401", "DP402"):
+        assert rid in out
+
+
+# ---------------- observe report dispatch ----------------
+
+
+def test_report_cli_dispatches_on_recert_dir(tmp_path, capsys):
+    from dorpatch_tpu.observe import report as report_cli
+
+    rdir = str(tmp_path / "recert")
+    sched = RecertScheduler(rdir, baseline_file=str(tmp_path / "rb.json"))
+    _cycle(sched, SPEC, update=True)
+    _cycle(sched, SPEC, ra=40.0)
+    assert report_cli.main([rdir]) == 0
+    out = capsys.readouterr().out
+    assert "= DorPatch re-certification report =" in out
+    assert "-- verdict" in out and "regressed" in out
+    assert "DP400" in out
+    assert report_cli.main([rdir, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["verdict"]["status"] == "failing"
+    assert payload["status"]["generation"] == 2

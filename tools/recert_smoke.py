@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Recert smoke: crash-resumed generation, regression gate, canary boot
+(CI gate, `run_tests.sh`).
+
+The continuous re-certification story, executed for real with separate
+scheduler processes over the tiny synthetic cifar10/resnet18@32 victim:
+
+1. a control scheduler runs ONE full generation uninterrupted — 2x2
+   (patch_budget x density) grid submitted to its private farm, drained by
+   the in-process farm worker running the real attack+certify sweep,
+   harvested and folded into a fresh robustness baseline;
+2. a chaos scheduler runs the same spec with
+   ``--chaos recert_kill_cycle,recert_torn_state``: the state file is torn
+   mid-byte and the process SIGKILLs itself right after the grid is
+   submitted — jobs live, nothing harvested, state file unreadable;
+3. a plain re-run of the chaos dir must recover from the torn state,
+   resume the SAME generation (never submit a second one), and commit a
+   baseline BYTE-IDENTICAL to the control's;
+4. a planted regression (baseline entry bumped past its tolerance) must
+   make ``recert check`` exit 1 naming the cell (DP400);
+5. serve boots against the now-failing verdict: ``--require-recert
+   strict`` refuses serving-ready with the typed `RecertGateError` before
+   any compile; ``warn`` boots (recompile watchdog armed), serves one
+   certified predict, and `GET /robustness` answers 503 rendering the
+   regressed cell.
+
+Prints ONE JSON line: {"metric": "recert_smoke", "ok": true, ...}; exits
+non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ATTACK = {"sampling_size": 4, "max_iterations": 4, "sweep_interval": 2,
+          "switch_iteration": 2, "dropout": 1, "dropout_sizes": [0.06],
+          "basic_unit": 4}
+SPEC = {
+    "base": {"dataset": "cifar10", "base_arch": "resnet18", "img_size": 32,
+             "batch_size": 2, "synthetic_data": True, "attack": ATTACK},
+    "axes": {"attack.patch_budget": [0.06, 0.12]},
+    "sweep": {"densities": [0.0, 0.5], "structureds": [1e-3],
+              "defense_ratio": 0.06},
+    "max_attempts": 2,
+}
+
+
+def _run_cmd(recert_dir, baseline_file, spec_path, extra=()):
+    return [sys.executable, "-m", "dorpatch_tpu.recert", "run", recert_dir,
+            "--spec", spec_path, "--baseline-file", baseline_file,
+            "--update-baseline", "--poll-interval", "0.1",
+            "--lease-ttl", "30", "--worker-id", "recert-smoke", *extra]
+
+
+def main(argv=None) -> int:
+    workdir = tempfile.mkdtemp(prefix="recert_smoke_")
+    spec_path = os.path.join(workdir, "spec.json")
+    with open(spec_path, "w") as fh:
+        json.dump(SPEC, fh)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               # one shared XLA compile cache across the scheduler
+               # subprocesses and this process's serve boot
+               JAX_COMPILATION_CACHE_DIR=os.path.join(workdir, "xla_cache"))
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = env["JAX_COMPILATION_CACHE_DIR"]
+
+    failures = []
+    t0 = time.time()
+
+    # ---- phase 1: uninterrupted control generation ----
+    control_dir = os.path.join(workdir, "control")
+    control_rb = os.path.join(workdir, "control_baseline.json")
+    control = subprocess.run(_run_cmd(control_dir, control_rb, spec_path),
+                             env=env, capture_output=True, text=True,
+                             timeout=1200)
+    if control.returncode != 0:
+        failures.append(f"control run exited {control.returncode}; stderr "
+                        f"tail: {control.stderr[-800:]}")
+    control_s = time.time() - t0
+    control_bytes = (open(control_rb, "rb").read()
+                     if os.path.exists(control_rb) else b"")
+    if not control_bytes:
+        failures.append("control run left no baseline file")
+
+    # ---- phase 2: torn state + SIGKILL mid-generation ----
+    chaos_dir = os.path.join(workdir, "chaos")
+    chaos_rb = os.path.join(workdir, "chaos_baseline.json")
+    killed = subprocess.run(
+        _run_cmd(chaos_dir, chaos_rb, spec_path,
+                 ("--chaos", "recert_kill_cycle,recert_torn_state",
+                  "--crash-mode", "kill")),
+        env=env, capture_output=True, text=True, timeout=600)
+    if killed.returncode != -signal.SIGKILL:
+        failures.append(
+            f"chaos scheduler exited {killed.returncode}, expected SIGKILL "
+            f"(-9); stderr tail: {killed.stderr[-800:]}")
+    state_path = os.path.join(chaos_dir, "recert_state.json")
+    try:
+        json.load(open(state_path))
+        failures.append("recert_torn_state left a parseable state file — "
+                        "the torn-write path was not exercised")
+    except (OSError, ValueError):
+        pass  # torn, as injected
+    if os.path.exists(chaos_rb):
+        failures.append("SIGKILLed generation must not have touched the "
+                        "baseline file (nothing was harvested)")
+
+    # ---- phase 3: resume completes the SAME generation, bit-identical ----
+    resume = subprocess.run(_run_cmd(chaos_dir, chaos_rb, spec_path),
+                            env=env, capture_output=True, text=True,
+                            timeout=1200)
+    if resume.returncode != 0:
+        failures.append(f"resume run exited {resume.returncode}; stderr "
+                        f"tail: {resume.stderr[-800:]}")
+
+    from dorpatch_tpu.recert.scheduler import RecertScheduler
+
+    sched = RecertScheduler(chaos_dir, baseline_file=chaos_rb)
+    st = sched.status()
+    if st["generation"] != 1 or st["inflight"] is not None:
+        failures.append("resume must finish generation 1, not start a new "
+                        f"one: status={st}")
+    chaos_bytes = (open(chaos_rb, "rb").read()
+                   if os.path.exists(chaos_rb) else b"")
+    if not chaos_bytes or chaos_bytes != control_bytes:
+        failures.append(
+            "crash-resumed baseline differs from the uninterrupted "
+            f"control's ({len(chaos_bytes)} vs {len(control_bytes)} bytes)")
+    verdict = st.get("verdict") or {}
+    if verdict.get("status") != "ok":
+        failures.append(f"resumed generation should verdict ok, got {verdict}")
+    cells = len(json.loads(chaos_bytes or b"{}").get("entries", {}))
+    if cells != 4:
+        failures.append(f"expected 4 grid cells in the baseline, got {cells}")
+
+    # ---- phase 4: planted regression -> check exits 1 naming the cell ----
+    data = json.loads(chaos_bytes.decode("utf-8")) if chaos_bytes else {
+        "entries": {}}
+    planted = next(iter(sorted(data["entries"])), None)
+    if planted is not None:
+        # claim the defense used to do 30 points better than it measured:
+        # the fresh measurement now reads as a regression past tolerance
+        data["entries"][planted]["robust_accuracy"] += 30.0
+        with open(chaos_rb, "w") as fh:
+            json.dump(data, fh)
+    check = subprocess.run(
+        [sys.executable, "-m", "dorpatch_tpu.recert", "check", chaos_dir,
+         "--baseline-file", chaos_rb],
+        env=env, capture_output=True, text=True, timeout=600)
+    if check.returncode != 1:
+        failures.append(f"check with a planted regression exited "
+                        f"{check.returncode}, expected 1; stderr tail: "
+                        f"{check.stderr[-400:]}")
+    if planted is None or "DP400" not in check.stdout \
+            or planted not in check.stdout:
+        failures.append("check finding must name DP400 and the regressed "
+                        f"cell {planted!r}; stdout: {check.stdout[-400:]}")
+
+    # ---- phase 5: serve boots against the failing verdict ----
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from dorpatch_tpu.config import DefenseConfig, RecertConfig, ServeConfig
+    from dorpatch_tpu.recert.gate import RecertGateError
+    from dorpatch_tpu.serve.http import HttpFrontend
+    from dorpatch_tpu.serve.service import CertifiedInferenceService
+
+    def stub_apply(params, x):
+        s = x.mean(axis=(1, 2, 3))
+        return jax.nn.one_hot((s * 7).astype(jnp.int32) % 5, 5)
+
+    def make(require):
+        return CertifiedInferenceService(
+            stub_apply, None, num_classes=5, img_size=32,
+            serve_cfg=ServeConfig(max_batch=2, bucket_sizes=(1, 2)),
+            defense_cfg=DefenseConfig(ratios=(0.1,), chunk_size=64),
+            recert_cfg=RecertConfig(dir=chaos_dir, require=require))
+
+    strict_refused = False
+    try:
+        make("strict").start()
+    except RecertGateError as e:
+        strict_refused = True
+        if "failing" not in str(e):
+            failures.append(f"strict refusal should carry the verdict "
+                            f"status: {e}")
+    if not strict_refused:
+        failures.append("--require-recert strict must refuse serving-ready "
+                        "on a failing verdict (typed RecertGateError)")
+
+    svc = make("warn").start()  # boots with the recompile watchdog armed
+    frontend = HttpFrontend(svc, port=0).start()
+    robustness_http = None
+    try:
+        resp = svc.predict(np.zeros((32, 32, 3), np.float32))
+        if resp.status != "ok":
+            failures.append(f"warn-mode service failed a predict: {resp}")
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{frontend.port}/robustness", timeout=30)
+            failures.append("/robustness must answer 503 on a failing "
+                            "verdict (canary-probe contract)")
+        except urllib.error.HTTPError as e:
+            robustness_http = e.code
+            body = json.loads(e.read().decode("utf-8"))
+            if e.code != 503 or body.get("status") != "failing":
+                failures.append(f"/robustness: expected 503/failing, got "
+                                f"{e.code}/{body.get('status')}")
+            regressed = [k for k, c in (body.get("cells") or {}).items()
+                         if c.get("status") == "regressed"]
+            if planted not in regressed:
+                failures.append("/robustness body must render the regressed "
+                                f"cell {planted!r}; got {regressed}")
+    finally:
+        frontend.stop()
+        svc.stop()
+
+    print(json.dumps({
+        "metric": "recert_smoke",
+        "ok": not failures,
+        "generation_s": round(control_s, 3),
+        "cells": cells,
+        "resume_generation": st.get("generation"),
+        "baseline_bytes": len(control_bytes),
+        "bit_identical": chaos_bytes == control_bytes,
+        "check_rc": check.returncode,
+        "strict_refused": strict_refused,
+        "robustness_http": robustness_http,
+        "failures": failures,
+    }, default=float))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        print(f"recert dirs kept for debugging: {workdir}", file=sys.stderr)
+        return 1
+    shutil.rmtree(workdir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
