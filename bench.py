@@ -856,6 +856,19 @@ def child_boot() -> None:
         warm_s = time.perf_counter() - t0
         stats = dict(svcw._aot_stats or {})
         warm_traces = sum(svcw.trace_counts().values())
+        # drive a few predictions through the warm bank and report the
+        # request counts straight from the service's metric registry —
+        # the same books /metrics and /stats serve
+        import numpy as np
+
+        from dorpatch_tpu.observe import labeled_values
+
+        rng = np.random.default_rng(3)
+        for image in rng.uniform(0.0, 1.0, (3, 32, 32, 3)).astype(np.float32):
+            svcw.predict(image, deadline_ms=15000.0)
+        served = {k: int(v) for k, v in sorted(labeled_values(
+            svcw.metrics.snapshot(), "serve_requests_total",
+            "status").items())}
         svcw.stop()
         print(json.dumps({
             "cold_s": round(cold_s, 3),
@@ -865,6 +878,7 @@ def child_boot() -> None:
                     "builds": int(stats.get("builds", 0)),
                     "store_state": ExecutableStore(store_dir).state_hash()},
             "warm_trace_count": int(warm_traces),
+            "warm_requests_by_status": served,
         }))
     finally:
         shutil.rmtree(store_dir, ignore_errors=True)

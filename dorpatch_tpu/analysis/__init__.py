@@ -3,7 +3,7 @@
 Four wings, one invariant set:
 
 - **AST** (`engine.py`, `rules_output.py`, `rules_jax.py`, `cli.py`):
-  rules DP101-DP107 with stable IDs, `# noqa: DPxxx` suppressions, a
+  rules DP101-DP108 with stable IDs, `# noqa: DPxxx` suppressions, a
   mechanical DP106 fixer (`fix.py`, `--fix`), and a CLI gate
   (`python -m dorpatch_tpu.analysis`, wired into `run_tests.sh`). Catches
   what is provable from source: bare prints, host syncs under trace, PRNG

@@ -3,7 +3,7 @@
 Four modes behind one exit contract (0 = clean, 1 = findings, 2 = usage
 error; `run_tests.sh` gates on it):
 
-- **Lint** (default): the AST rules (DP101-DP107) over the package and
+- **Lint** (default): the AST rules (DP101-DP108) over the package and
   tools — pure ast/tokenize logic, never initializes a jax backend.
 - **Trace** (`--trace`): the jaxpr-level auditor (DP200-DP206) over every
   registered production jit entry point, abstractly traced on CPU
@@ -58,7 +58,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m dorpatch_tpu.analysis",
         description="Static analysis for the dorpatch-tpu tree: AST rules "
-                    "DP101-DP107 (default), the jaxpr-level program "
+                    "DP101-DP108 (default), the jaxpr-level program "
                     "auditor DP200-DP206 (--trace), and the program-"
                     "baseline drift gate DP300-DP304 (--baseline); see "
                     "--list-rules")

@@ -1,6 +1,7 @@
 """JAX-aware rules: DP102 host-sync-in-jit, DP103 PRNG key reuse,
 DP104 literal PRNGKey seeds, DP105 unwrapped jax.jit call sites,
-DP107 host syncs in serve/ outside the marshalling point.
+DP107 host syncs in serve/ outside the marshalling point,
+DP108 hand-rolled counter state in serve//farm/ outside the registry.
 
 What these protect (PAPER.md "EOT inner loop", ROADMAP north star):
 
@@ -23,6 +24,11 @@ What these protect (PAPER.md "EOT inner loop", ROADMAP north star):
   the designated `marshal_response` function stalls the dispatch pipeline
   per batch and silently serializes the micro-batching hot path. (DP102
   can't see these: serving code is eager host code, not jitted bodies.)
+- DP108: fleet accounting reads ONE typed registry (`observe.metrics`) —
+  a hand-rolled `self.completed += 1` in serve/ or farm/ is a counter the
+  `/metrics` exposition, `/stats`, the report CLI and the loadgen
+  cross-check can never see, so the books silently fork. Control state
+  that is genuinely not a metric carries a reasoned `# noqa: DP108`.
 """
 
 from __future__ import annotations
@@ -514,4 +520,48 @@ class ServeHostSyncRule(Rule):
             # reasoned `# noqa: DP107`.
             return (f"{target}() materializes a device array on the host "
                     "when fed one" + tail)
+        return None
+
+
+@register
+class AdHocCounterRule(Rule):
+    id = "DP108"
+    name = "adhoc-counter-state"
+    description = ("hand-rolled counter/gauge mutation in serve//farm/ "
+                   "outside observe.metrics — accounting the /metrics "
+                   "exposition and the fleet cross-check cannot see")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_package():
+            return
+        if not {"serve", "farm"} & set(ctx.scoped_parts):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.AugAssign):
+                continue
+            if not isinstance(node.op, (ast.Add, ast.Sub)):
+                continue
+            spelled = self._attr_target(node.target)
+            if spelled is None:
+                continue
+            yield self.finding(
+                ctx, node,
+                f"`{spelled} {'+=' if isinstance(node.op, ast.Add) else '-='}"
+                f" ...` is counter state outside the metric registry — "
+                f"route it through observe.metrics (MetricRegistry.counter/"
+                f"gauge) so /metrics, /stats and the report CLI read one "
+                f"set of books, or mark genuine control state with a "
+                f"reasoned `# noqa: DP108`")
+
+    @staticmethod
+    def _attr_target(target: ast.AST) -> Optional[str]:
+        """The flagged spelling for attribute-state mutations: `x.attr` and
+        `x.attr[key]`. Plain locals (`n += 1`) and Name-rooted subscripts
+        (`counts[k] += 1` on a local dict) are loop bookkeeping, not
+        published state, and stay exempt."""
+        if isinstance(target, ast.Attribute):
+            return f"<obj>.{target.attr}"
+        if isinstance(target, ast.Subscript) \
+                and isinstance(target.value, ast.Attribute):
+            return f"<obj>.{target.value.attr}[...]"
         return None

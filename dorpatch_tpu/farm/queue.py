@@ -53,7 +53,7 @@ from typing import Dict, List, Optional, Tuple
 # same formula); re-exported here because the farm grew it first.
 from dorpatch_tpu.backoff import retry_delay  # noqa: F401
 from dorpatch_tpu.checkpoint import atomic_write_json, load_json
-from dorpatch_tpu.observe.heartbeat import last_beat_ts
+from dorpatch_tpu.observe.heartbeat import last_beat
 
 FARM_NAME = "farm.json"
 JOB_NAME = "job.json"
@@ -90,10 +90,18 @@ def job_slug(params: Dict) -> str:
 class JobQueue:
     """All reads/writes of one farm directory's job + lease state."""
 
-    def __init__(self, farm_dir: str, clock=time.time):
+    def __init__(self, farm_dir: str, clock=time.time, metrics=None):
         self.farm_dir = os.path.abspath(farm_dir)
         self.jobs_dir = os.path.join(self.farm_dir, "jobs")
         self._clock = clock
+        # optional observe.MetricRegistry: claim/reclaim tallies land there
+        # so the worker's heartbeat + /metrics surface them live
+        self.metrics = metrics
+        # seq-freshness cache: heartbeat path -> (last seen seq, OUR clock
+        # when it was first seen). Lease liveness must survive wall-clock
+        # skew between hosts, so advancement of the writer's monotonic
+        # `seq` — timed on the READER's clock — outranks the beat's `ts`.
+        self._hb_seq: Dict[str, Tuple[int, float]] = {}
 
     # ---------------- submit ----------------
 
@@ -233,14 +241,33 @@ class JobQueue:
     def lease_fresh(self, lease: Dict) -> bool:
         """Heartbeat-driven liveness: the lease is fresh while the owner's
         heartbeat file advanced within the TTL. Workers without a readable
-        heartbeat fall back to the renewed `expires_ts`."""
+        heartbeat fall back to the renewed `expires_ts`.
+
+        Freshness prefers the beat's monotonic ``seq`` over its wall-clock
+        ``ts``: a live worker whose clock runs behind ours keeps its lease
+        because its seq keeps advancing (measured on OUR clock), and a dead
+        worker whose final beat carried a future ts still loses it once the
+        seq has been frozen for a full TTL of local time. The ts comparison
+        only decides when seq gives no verdict (first observation of a
+        file, or a pre-seq beat record)."""
         ttl = float(lease.get("ttl", 60.0))
         now = self._clock()
         hb_path = lease.get("heartbeat") or ""
         if hb_path:
-            ts = last_beat_ts(hb_path)
-            if ts is not None:
-                return (now - ts) <= ttl
+            beat = last_beat(hb_path)
+            if beat is not None:
+                seq = beat.get("seq")
+                if isinstance(seq, int):
+                    prev = self._hb_seq.get(hb_path)
+                    if prev is not None and seq != prev[0]:
+                        # advancement since our last look: alive, full stop
+                        self._hb_seq[hb_path] = (seq, now)
+                        return True
+                    if prev is None:
+                        self._hb_seq[hb_path] = (seq, now)
+                    elif now - prev[1] > ttl:
+                        return False  # frozen a whole TTL on OUR clock: dead
+                return (now - float(beat["ts"])) <= ttl
         return now <= float(lease.get("expires_ts", 0.0))
 
     def _lease_record(self, job_id: str, worker_id: str, ttl: float,
@@ -340,7 +367,15 @@ class JobQueue:
                                         heartbeat_path):
                 continue
             fields = {"state": "leased", "worker": worker_id}
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "farm_jobs_claimed_total",
+                    help="lease claims won by this worker").inc()
             if is_reclaim:
                 fields["reclaims"] = int(job.get("reclaims", 0)) + 1
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "farm_jobs_reclaimed_total",
+                        help="claims that took over a stale lease").inc()
             return self._commit(job, **fields)
         return None

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 
 from dorpatch_tpu.checkpoint import load_json
 from dorpatch_tpu.farm.queue import FARM_NAME, JobQueue
+from dorpatch_tpu.observe.heartbeat import heartbeat_filename, last_beat
 
 ROW_KEYS = ("patch_budget", "density", "structured",
             "robust_accuracy", "certified_asr_pc")
@@ -155,18 +156,42 @@ def summarize_fleet(farm_dir: str) -> Optional[dict]:
             **step_time,
         })
     # per-worker AOT warm-boot accounting (workers/<id>/aot.json, written
-    # by FarmWorker.run when booting against a shared executable store)
+    # by FarmWorker.run when booting against a shared executable store),
+    # live job counters from the newest heartbeat beat (present while the
+    # worker is still running — the beats carry them), and the final
+    # metric-registry snapshot (workers/<id>/metrics.json)
     aot_by_worker: Dict[str, dict] = {}
+    workers: Dict[str, dict] = {}
+    metrics_by_worker: Dict[str, dict] = {}
     workers_dir = os.path.join(farm_dir, "workers")
     if os.path.isdir(workers_dir):
         for wid in sorted(os.listdir(workers_dir)):
-            rec = load_json(os.path.join(workers_dir, wid, "aot.json"))
+            wdir = os.path.join(workers_dir, wid)
+            rec = load_json(os.path.join(wdir, "aot.json"))
             if isinstance(rec, dict):
                 aot_by_worker[wid] = {
                     "hits": int(rec.get("hits", 0)),
                     "misses": int(rec.get("misses", 0)),
                     "load_s": float(rec.get("load_s", 0.0)),
                 }
+            beat = last_beat(os.path.join(wdir, heartbeat_filename(0)))
+            if beat is not None:
+                workers[wid] = {
+                    k: beat[k] for k in (
+                        "phase", "seq", "ts", "jobs_done", "jobs_failed",
+                        "jobs_quarantined", "jobs_abandoned",
+                        "jobs_claimed", "jobs_reclaimed") if k in beat}
+            snap = load_json(os.path.join(wdir, "metrics.json"))
+            if isinstance(snap, dict):
+                totals = {}
+                for name, m in sorted(
+                        (snap.get("metrics") or {}).items()):
+                    if m.get("type") != "counter":
+                        continue
+                    totals[name] = sum(
+                        float(s.get("value", 0.0))
+                        for s in m.get("series", []))
+                metrics_by_worker[wid] = totals
     return {
         "farm_dir": os.path.abspath(farm_dir),
         "spec_jobs": int(farm.get("jobs", 0)),
@@ -180,6 +205,8 @@ def summarize_fleet(farm_dir: str) -> Optional[dict]:
                       "wasted_s": round(wasted_s, 3),
                       "reexecuted_blocks": reexecuted_blocks},
         "aot_by_worker": aot_by_worker,
+        "workers": workers,
+        "metrics_by_worker": metrics_by_worker,
         "points": points,
         "jobs": jobs,
     }
@@ -217,6 +244,15 @@ def format_fleet_report(s: dict) -> str:
         add("  aot warm boot: " + ", ".join(
             f"{w}: {a['hits']} hit(s)/{a['misses']} miss(es)"
             for w, a in sorted(s["aot_by_worker"].items())))
+    for wid, w in sorted(s.get("workers", {}).items()):
+        add(f"  worker {wid}: phase {w.get('phase', '')!r} "
+            f"(beat seq {w.get('seq', '?')}) — "
+            f"claimed {w.get('jobs_claimed', 0)}, "
+            f"done {w.get('jobs_done', 0)}, "
+            f"failed {w.get('jobs_failed', 0)}, "
+            f"quarantined {w.get('jobs_quarantined', 0)}, "
+            f"abandoned {w.get('jobs_abandoned', 0)}, "
+            f"reclaimed {w.get('jobs_reclaimed', 0)}")
     for q in s["quarantined"]:
         add(f"  quarantined {q['id']}: [{q['kind']}] {q['error'][:90]}")
     add("-- jobs --")

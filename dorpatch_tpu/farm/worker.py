@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal
+import threading
 import time
 import traceback
 from typing import Callable, Dict, Optional, Tuple
@@ -149,7 +151,12 @@ class FarmWorker:
                  runner: Optional[Callable[[Dict, JobContext], Dict]] = None,
                  clock=time.time, sleep=time.sleep,
                  aot_store: str = "", aot_mode: str = "auto"):
-        self.queue = farm_queue.JobQueue(farm_dir, clock=clock)
+        # one registry per worker process: the queue's claim/reclaim
+        # tallies, the drain loop's outcome counters, and the heartbeat's
+        # live `jobs_*` fields all read/write the same series
+        self.metrics = observe.MetricRegistry()
+        self.queue = farm_queue.JobQueue(farm_dir, clock=clock,
+                                         metrics=self.metrics)
         self.worker_id = worker_id or f"w{os.getpid()}"
         self.lease_ttl = float(lease_ttl)
         self.backoff_base = float(backoff_base)
@@ -176,8 +183,45 @@ class FarmWorker:
                                            observe.heartbeat_filename(0))
         self._phase = "idle"
         self._heartbeat: Optional[observe.Heartbeat] = None
+        self._m_jobs = self.metrics.counter(
+            "farm_jobs_total", help="handled jobs by terminal outcome")
+        self._m_retries = self.metrics.counter(
+            "farm_job_retries_total",
+            help="claims of a job that had already been attempted")
 
     # ---------------- the drain loop ----------------
+
+    def _beat_extra(self) -> Dict:
+        """Live job counters folded into every heartbeat beat, so `farm
+        report` shows fleet throughput while workers are still running."""
+        m = self.metrics
+        return {
+            "jobs_done": int(m.value("farm_jobs_total", outcome="done")),
+            "jobs_failed": int(m.value("farm_jobs_total", outcome="failed")),
+            "jobs_quarantined": int(
+                m.value("farm_jobs_total", outcome="quarantined")),
+            "jobs_abandoned": int(
+                m.value("farm_jobs_total", outcome="abandoned")),
+            "jobs_claimed": int(m.value("farm_jobs_claimed_total")),
+            "jobs_reclaimed": int(m.value("farm_jobs_reclaimed_total")),
+        }
+
+    def _install_profile_signal(self):
+        """SIGUSR2 -> bounded on-demand `jax.profiler` capture into the
+        worker dir, without interrupting the job (the capture runs on its
+        own thread; the signal handler only launches it). Returns the
+        previous handler, or None when not installable (non-main thread,
+        e.g. a worker driven from a test thread)."""
+        def _handler(signum, frame):
+            threading.Thread(
+                target=observe.capture_profile, args=(self.worker_dir,),
+                kwargs={"duration_s": 1.0},
+                name="farm-profile", daemon=True).start()
+
+        try:
+            return signal.signal(signal.SIGUSR2, _handler)
+        except ValueError:
+            return None
 
     def run(self, max_jobs: Optional[int] = None) -> Dict:
         """Claim and run jobs until the queue is drained (or `max_jobs`
@@ -203,9 +247,11 @@ class FarmWorker:
             if resolver is not None:
                 prev_resolver = observe.aot_resolver()
                 observe.set_aot_resolver(resolver)
+        prev_sig = self._install_profile_signal()
         heartbeat = observe.Heartbeat(
             self.heartbeat_path, get_phase=lambda: self._phase,
-            interval=self.heartbeat_interval, clock=self._clock)
+            interval=self.heartbeat_interval, clock=self._clock,
+            extra=self._beat_extra)
         with heartbeat:
             self._heartbeat = heartbeat
             try:
@@ -223,8 +269,11 @@ class FarmWorker:
                             break
                         self._sleep(self.poll_interval)
                         continue
+                    if int(job.get("attempts", 0)) > 0:
+                        self._m_retries.inc()
                     outcome = self.run_one(job)
                     summary[outcome] += 1
+                    self._m_jobs.inc(outcome=outcome)
                     if (outcome == "abandoned" and self.chaos_faults
                             and "wedge_heartbeat" in self.chaos_faults):
                         # our beats stopped: every lease we'd take is born
@@ -234,6 +283,11 @@ class FarmWorker:
                         break
             finally:
                 self._heartbeat = None
+                if prev_sig is not None:
+                    try:
+                        signal.signal(signal.SIGUSR2, prev_sig)
+                    except ValueError:
+                        pass
                 if resolver is not None:
                     observe.set_aot_resolver(prev_resolver)
                     summary["aot"] = dict(resolver.stats)
@@ -241,6 +295,8 @@ class FarmWorker:
                     checkpoint.atomic_write_json(
                         os.path.join(self.worker_dir, "aot.json"),
                         {"worker": self.worker_id, **resolver.stats})
+                self.metrics.dump(
+                    os.path.join(self.worker_dir, "metrics.json"))
         summary["counts"] = self.queue.counts()
         return summary
 
@@ -262,13 +318,18 @@ class FarmWorker:
         jq.mark_running(job, self.worker_id)
         self._phase = f"job/{job_id}"
         run_id = observe.new_run_id()
+        # the job's cross-process correlation id, minted at ingress (the
+        # claim): every record of this attempt carries it, so the fleet
+        # report can join a serve/farm/recert trace end to end
+        trace_id = observe.new_trace_id()
         try:
             os.makedirs(result_dir, exist_ok=True)
             cfg = job_config(job)
             observe.write_run_manifest(
                 result_dir, cfg, run_id=run_id,
                 extra={"farm": {"job": job_id, "worker": self.worker_id,
-                                "attempt": job["attempts"]}})
+                                "attempt": job["attempts"],
+                                "trace": trace_id}})
 
             def on_block(stage: int, iteration: int,
                          info: Optional[dict] = None) -> None:
@@ -302,8 +363,12 @@ class FarmWorker:
             if chaos is not None:
                 chaos.wrap_event_log(event_log)
             with event_log, observe.active(event_log):
+                observe.record_event("farm.job.claim", job=job_id,
+                                     worker=self.worker_id,
+                                     attempt=job["attempts"],
+                                     trace=trace_id, opens_trace=True)
                 with observe.span("farm.job", job=job_id,
-                                  attempt=job["attempts"]):
+                                  attempt=job["attempts"], trace=trace_id):
                     result = self.runner(job, ctx)
         except LeaseLost:
             observe.log(f"worker {self.worker_id}: abandoned {job_id} "

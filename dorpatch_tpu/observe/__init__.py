@@ -57,6 +57,7 @@ from dorpatch_tpu.observe.heartbeat import (  # noqa: F401
     Watchdog,
     heartbeat_filename,
     heartbeat_gaps,
+    last_beat,
     last_beat_ts,
     read_heartbeats,
     summarize_heartbeats,
@@ -64,11 +65,21 @@ from dorpatch_tpu.observe.heartbeat import (  # noqa: F401
 from dorpatch_tpu.observe.manifest import (  # noqa: F401
     jax_environment,
     new_run_id,
+    new_trace_id,
     run_manifest,
     write_run_manifest,
 )
+from dorpatch_tpu.observe.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    labeled_values,
+    parse_exposition,
+)
 from dorpatch_tpu.observe.timing import (  # noqa: F401
     StepTimer,
+    capture_profile,
     nearest_rank_percentile,
     trace,
 )
@@ -76,13 +87,18 @@ from dorpatch_tpu.observe.timing import (  # noqa: F401
 __all__ = [
     "METRIC_NAMES",
     "AttackMetricsLogger",
+    "Counter",
     "EventLog",
+    "Gauge",
     "Heartbeat",
+    "Histogram",
+    "MetricRegistry",
     "StepTimer",
     "Watchdog",
     "active",
     "active_event_log",
     "aot_resolver",
+    "capture_profile",
     "device_memory_stats",
     "elapsed",
     "entrypoint_recorder",
@@ -90,10 +106,14 @@ __all__ = [
     "heartbeat_filename",
     "heartbeat_gaps",
     "jax_environment",
+    "labeled_values",
+    "last_beat",
     "last_beat_ts",
     "log",
     "nearest_rank_percentile",
     "new_run_id",
+    "new_trace_id",
+    "parse_exposition",
     "process_index",
     "read_heartbeats",
     "record_compile",

@@ -43,12 +43,17 @@ class Heartbeat:
     def __init__(self, path: Optional[str],
                  get_phase: Optional[Callable[[], str]] = None,
                  interval: float = 5.0, process_index: int = 0,
-                 run_id: str = "", clock=time.time):
+                 run_id: str = "", clock=time.time,
+                 extra: Optional[Callable[[], Dict]] = None):
         self.path = path
         self.interval = max(float(interval), 0.01)
         self.process_index = process_index
         self.run_id = run_id
         self._get_phase = get_phase
+        # `extra` folds a caller dict into every beat (farm workers ship
+        # their live job counters this way, so `farm report` can show
+        # throughput without waiting for the run to finish)
+        self._get_extra = extra
         self._clock = clock
         self._seq = 0
         self._lock = threading.Lock()
@@ -67,10 +72,18 @@ class Heartbeat:
     def beat(self, phase: Optional[str] = None) -> dict:
         if phase is None:
             phase = self._get_phase() if self._get_phase is not None else ""
+        extra = {}
+        if self._get_extra is not None:
+            try:
+                extra = dict(self._get_extra())
+            except Exception:
+                extra = {}  # a broken producer must not stop the beats
         with self._lock:
             rec = {"ts": round(self._clock(), 3), "seq": self._seq,
                    "phase": phase, "proc": self.process_index,
                    "pid": os.getpid()}
+            for key, value in extra.items():
+                rec.setdefault(str(key), value)
             if self.run_id:
                 rec["run_id"] = self.run_id
             self._seq += 1
@@ -159,14 +172,17 @@ def read_heartbeats(result_dir: str) -> Dict[str, List[dict]]:
     return out
 
 
-def last_beat_ts(path: str) -> Optional[float]:
-    """Timestamp of the newest parseable beat in ONE heartbeat file, or None
+def last_beat(path: str) -> Optional[dict]:
+    """The newest parseable beat RECORD in ONE heartbeat file, or None
     when the file is missing/empty/unreadable.
 
     This is the farm's lease-liveness primitive: a worker's lease is fresh
     exactly while its heartbeat file keeps advancing, so the reader must be
     cheap (tail read, not a full parse) and must tolerate a final line
-    truncated by the very crash it is there to detect."""
+    truncated by the very crash it is there to detect. Callers that care
+    about liveness under wall-clock skew should prefer the monotonic
+    ``seq`` field over ``ts`` (`farm.queue.lease_fresh` tracks seq
+    advancement against its OWN clock)."""
     try:
         with open(path, "rb") as fh:
             fh.seek(0, os.SEEK_END)
@@ -181,10 +197,17 @@ def last_beat_ts(path: str) -> Optional[float]:
             continue
         try:
             rec = json.loads(line)
-            return float(rec["ts"])
+            float(rec["ts"])  # a beat without a parseable ts is torn
         except (ValueError, KeyError, TypeError):
             continue
+        return rec
     return None
+
+
+def last_beat_ts(path: str) -> Optional[float]:
+    """Timestamp of the newest parseable beat (see `last_beat`)."""
+    rec = last_beat(path)
+    return None if rec is None else float(rec["ts"])
 
 
 def heartbeat_gaps(beats: List[dict]) -> List[float]:

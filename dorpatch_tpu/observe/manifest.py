@@ -34,6 +34,14 @@ def new_run_id() -> str:
     return uuid.uuid4().hex[:12]
 
 
+def new_trace_id() -> str:
+    """Per-REQUEST correlation id, minted once at ingress (HTTP request,
+    farm job claim, recert generation) and threaded through every process
+    that touches the work — one adversarial query is one joinable identity
+    across `events.jsonl` files (`observe.report --fleet`)."""
+    return uuid.uuid4().hex[:16]
+
+
 def git_sha() -> Optional[str]:
     """Best-effort SHA of the checkout this package runs from."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 
 from dorpatch_tpu.observe.heartbeat import summarize_heartbeats
 from dorpatch_tpu.observe.manifest import MANIFEST_NAME
+from dorpatch_tpu.observe.metrics import labeled_values
 from dorpatch_tpu.observe.timing import StepTimer, nearest_rank_percentile
 
 
@@ -654,19 +655,207 @@ def format_report(s: dict) -> str:
     return "\n".join(lines)
 
 
+# ---------------- cross-process fleet join (--fleet) ----------------
+
+
+def summarize_fleet_dirs(dirs: List[str]) -> dict:
+    """Merge several run/farm/recert dirs into one cross-process view.
+
+    Two joins, both file-only:
+
+    - **trace correlation** — every ingress (HTTP request, farm job claim,
+      recert generation begin) records an `opens_trace` event carrying its
+      trace id; every downstream record carries the same id (`trace` field,
+      or the `traces` list on serve.batch span closes). An opened trace
+      that no other record ever mentions is an ORPHAN: work that entered
+      the system and left no telemetry of being answered.
+    - **counter reconciliation** — the client-side registry snapshot
+      (`metrics_client.json` from tools/loadgen.py) against the server-side
+      snapshots (`metrics.json` from serve/farm/recert): per-status request
+      counts must agree bit-for-bit, and the farm's outcome counters are
+      folded in so a fleet that lost work cannot read as healthy.
+    """
+    events: List[dict] = []
+    event_files = 0
+    server_snaps: List[dict] = []
+    client_snaps: List[dict] = []
+    for d in dirs:
+        for root, _dirnames, files in os.walk(d):
+            for fname in sorted(files):
+                path = os.path.join(root, fname)
+                if fname.startswith("events") and fname.endswith(".jsonl"):
+                    event_files += 1
+                    events.extend(_read_jsonl(path))
+                elif fname == "metrics.json":
+                    snap = _load_metrics_snapshot(path)
+                    if snap is not None:
+                        server_snaps.append(snap)
+                elif fname == "metrics_client.json":
+                    snap = _load_metrics_snapshot(path)
+                    if snap is not None:
+                        client_snaps.append(snap)
+
+    opened: Dict[str, str] = {}
+    closed: set = set()
+    for r in events:
+        ids = []
+        trace = r.get("trace")
+        if isinstance(trace, str) and trace:
+            ids.append(trace)
+        traces = r.get("traces")
+        # only a LIST is a trace-id fan-out; `sanitize.retrace` events
+        # reuse the key for an integer trace-cache size
+        if isinstance(traces, (list, tuple)):
+            for t in traces:
+                if isinstance(t, str) and t:
+                    ids.append(t)
+        if not ids:
+            continue
+        if r.get("opens_trace"):
+            for t in ids:
+                opened.setdefault(t, str(r.get("name", "?")))
+        else:
+            closed.update(ids)
+    orphans = sorted(t for t in opened if t not in closed)
+
+    server_status = _sum_labeled(server_snaps, "serve_requests_total",
+                                 "status")
+    client_status = _sum_labeled(client_snaps, "loadgen_requests_total",
+                                 "status")
+    farm_outcomes = _sum_labeled(server_snaps, "farm_jobs_total", "outcome")
+    recert_status = _sum_labeled(server_snaps, "recert_generations_total",
+                                 "status")
+
+    checks: List[dict] = []
+    if client_snaps:
+        for status in sorted(set(server_status) | set(client_status)):
+            client_n = int(client_status.get(status, 0))
+            server_n = int(server_status.get(status, 0))
+            checks.append({"status": status, "client": client_n,
+                           "server": server_n,
+                           "ok": client_n == server_n})
+    consistent = all(c["ok"] for c in checks) and not orphans
+    return {
+        "dirs": [os.path.abspath(d) for d in dirs],
+        "events_files": event_files,
+        "records": len(events),
+        "snapshots": {"server": len(server_snaps),
+                      "client": len(client_snaps)},
+        "traces": {"opened": len(opened), "closed_or_referenced": len(closed),
+                   "orphans": orphans,
+                   "opened_by_kind": _count_values(opened.values())},
+        "requests": {"server_by_status": server_status,
+                     "client_by_status": client_status},
+        "farm_jobs_by_outcome": farm_outcomes,
+        "recert_generations_by_status": recert_status,
+        "checks": checks,
+        "consistent": consistent,
+    }
+
+
+def _load_metrics_snapshot(path: str) -> Optional[dict]:
+    try:
+        with open(path) as fh:
+            snap = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return snap if isinstance(snap, dict) and "metrics" in snap else None
+
+
+def _sum_labeled(snaps: List[dict], name: str, label: str) -> Dict[str, int]:
+    """Sum one counter's series across snapshots, keyed by `label` value."""
+    out: Dict[str, int] = {}
+    for snap in snaps:
+        for value, count in labeled_values(snap, name, label).items():
+            out[value] = out.get(value, 0) + int(count)
+    return dict(sorted(out.items()))
+
+
+def _count_values(values) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for v in values:
+        out[v] = out.get(v, 0) + 1
+    return dict(sorted(out.items()))
+
+
+def format_fleet_dirs(s: dict) -> str:
+    """Human rendering of a `summarize_fleet_dirs()` dict."""
+    lines: List[str] = []
+    add = lines.append
+    add("= DorPatch fleet telemetry report =")
+    for d in s["dirs"]:
+        add(f"dir: {d}")
+    add(f"records: {s['records']} across {s['events_files']} events file(s); "
+        f"{s['snapshots']['server']} server / {s['snapshots']['client']} "
+        f"client metric snapshot(s)")
+    add("-- cross-process --")
+    tr = s["traces"]
+    kinds = ", ".join(f"{k}: {v}" for k, v in tr["opened_by_kind"].items())
+    add(f"  traces opened: {tr['opened']} ({kinds or 'none'})")
+    if tr["orphans"]:
+        add(f"  !! ORPHANED traces ({len(tr['orphans'])}): work entered but "
+            "no other record ever mentioned it")
+        for t in tr["orphans"][:8]:
+            add(f"     {t}")
+    else:
+        add("  orphaned traces: 0 — every ingress joined to downstream "
+            "telemetry")
+    rq = s["requests"]
+    if rq["server_by_status"]:
+        add("  server requests: " + ", ".join(
+            f"{k}: {v}" for k, v in rq["server_by_status"].items()))
+    if rq["client_by_status"]:
+        add("  client requests: " + ", ".join(
+            f"{k}: {v}" for k, v in rq["client_by_status"].items()))
+    for c in s["checks"]:
+        verdict = "ok" if c["ok"] else "MISMATCH"
+        add(f"  [{verdict:>8}] {c['status']}: client {c['client']} "
+            f"vs server {c['server']}")
+    if s["farm_jobs_by_outcome"]:
+        add("  farm jobs: " + ", ".join(
+            f"{k}: {v}" for k, v in s["farm_jobs_by_outcome"].items()))
+    if s["recert_generations_by_status"]:
+        add("  recert generations: " + ", ".join(
+            f"{k}: {v}" for k, v in
+            s["recert_generations_by_status"].items()))
+    add("  consistent: " + ("yes" if s["consistent"] else "NO"))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m dorpatch_tpu.observe.report",
         description="Offline telemetry report for a DorPatch results dir")
-    p.add_argument("result_dir", help="results dir holding run.json / "
-                                      "events.jsonl / metrics.jsonl / "
-                                      "heartbeat_*.jsonl")
+    p.add_argument("result_dir", nargs="?", default=None,
+                   help="results dir holding run.json / "
+                        "events.jsonl / metrics.jsonl / "
+                        "heartbeat_*.jsonl")
+    p.add_argument("--fleet", nargs="+", metavar="DIR",
+                   help="merge several run/farm/recert dirs: cross-process "
+                        "trace correlation + client/server counter "
+                        "reconciliation")
     p.add_argument("--json", action="store_true",
                    help="emit the machine-readable summary instead of text")
     p.add_argument("--stall-factor", type=float, default=5.0,
                    help="heartbeat gap > factor x median interval = stall")
     args = p.parse_args(argv)
 
+    if args.fleet:
+        bad = [d for d in args.fleet if not os.path.isdir(d)]
+        if bad:
+            print(f"not a directory: {', '.join(bad)}")
+            return 2
+        fleet = summarize_fleet_dirs(args.fleet)
+        try:
+            if args.json:
+                print(json.dumps(fleet, indent=1, default=float))
+            else:
+                print(format_fleet_dirs(fleet))
+        except BrokenPipeError:
+            return 0
+        return 0 if fleet["consistent"] else 1
+    if args.result_dir is None:
+        p.error("result_dir is required unless --fleet is given")
     if not os.path.isdir(args.result_dir):
         print(f"not a directory: {args.result_dir}")
         return 2

@@ -5,6 +5,8 @@ from __future__ import annotations
 
 import contextlib
 import math
+import os
+import threading
 from typing import Optional, Sequence
 
 import time
@@ -85,3 +87,40 @@ def trace(trace_dir: Optional[str]):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# One capture at a time per process: `jax.profiler.start_trace` is a
+# process-global toggle, so overlapping captures would corrupt each other.
+_PROFILE_LOCK = threading.Lock()
+
+
+def capture_profile(out_dir: Optional[str], duration_s: float = 0.5,
+                    max_duration_s: float = 10.0,
+                    sleep=time.sleep) -> Optional[str]:
+    """On-demand bounded-duration `jax.profiler` device-trace capture.
+
+    Traces everything the process launches for (clamped) `duration_s`
+    into ``<out_dir>/profile`` and emits a ``profile.captured`` event on
+    the active EventLog. Serving keeps answering while the capture runs —
+    this is the `POST /profile` / farm SIGUSR2 hook, not a pause button.
+    Returns the trace dir, or None when `out_dir` is falsy or another
+    capture is already in flight (the lock is never waited on: a second
+    concurrent request is refused, not queued)."""
+    if not out_dir:
+        return None
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        return None
+    try:
+        dur = max(0.05, min(float(duration_s), float(max_duration_s)))
+        trace_dir = os.path.join(out_dir, "profile")
+        t0 = time.perf_counter()
+        with trace(trace_dir):
+            sleep(dur)
+        from dorpatch_tpu.observe import events
+
+        events.record_event("profile.captured", dir=trace_dir,
+                            duration_s=round(dur, 3),
+                            wall_s=round(time.perf_counter() - t0, 3))
+        return trace_dir
+    finally:
+        _PROFILE_LOCK.release()

@@ -37,6 +37,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from dorpatch_tpu import observe
 from dorpatch_tpu.checkpoint import atomic_write_json, load_json
 from dorpatch_tpu.farm.queue import FARM_NAME, JobQueue
 from dorpatch_tpu.farm.report import read_result_rows
@@ -76,6 +77,20 @@ class RecertScheduler:
         self.baseline_file = baseline_file or str(rbase.baseline_path())
         self._clock = clock
         self.chaos = chaos
+        # generation timing/outcome series; snapshotted to
+        # <recert_dir>/metrics.json at every completion so the fleet
+        # report reads recert the same way it reads serve and farm dirs
+        self.metrics = observe.MetricRegistry()
+
+    def _record(self, name: str, **fields) -> None:
+        """Append one event to the recert dir's own events.jsonl (the
+        scheduler runs outside any job's event log, but its generation
+        begin/complete records must land somewhere the fleet report can
+        join on trace id)."""
+        log = observe.EventLog(
+            os.path.join(self.recert_dir, observe.events_filename(0)))
+        with log:
+            log.event(name, **fields)
 
     # ---------------- state ----------------
 
@@ -160,10 +175,18 @@ class RecertScheduler:
             generation = int(state.get("generation", 0)) + 1
             inflight = {"generation": generation,
                         "farm_dir": f"{GEN_PREFIX}{generation:04d}",
-                        "spec": spec}
+                        "spec": spec,
+                        # generation wall-clock start + the cross-process
+                        # correlation id minted at THIS ingress: both ride
+                        # the inflight record so a crash/resume keeps the
+                        # original start and trace, not a fresh pair
+                        "began_ts": round(self._clock(), 3),
+                        "trace": observe.new_trace_id()}
             atomic_write_json(self.state_path, {
                 "version": 1, "generation": state.get("generation", 0),
                 "inflight": inflight})
+            self._record("recert.generation.begin", generation=generation,
+                         trace=inflight["trace"], opens_trace=True)
         farm_dir = os.path.join(self.recert_dir, inflight["farm_dir"])
         if spec is None:
             raise RecertError(
@@ -255,14 +278,43 @@ class RecertScheduler:
                                       findings,
                                       baseline_file=self.baseline_file)
         atomic_write_json(self.verdict_path, verdict)
-        atomic_write_json(os.path.join(farm_dir, COMPLETE_NAME), {
+        # generation timing + trace come off the inflight record (absent
+        # after a state recovery — seconds then reads null, never wrong)
+        inflight = self.load_state().get("inflight") or {}
+        began_ts = inflight.get("began_ts")
+        trace = inflight.get("trace", "")
+        seconds = (round(self._clock() - float(began_ts), 3)
+                   if began_ts is not None else None)
+        marker = {
             "generation": int(generation),
             "measured": len(measured),
             "holes": holes,
             "status": verdict["status"],
-        })
+        }
+        if seconds is not None:
+            marker["seconds"] = seconds
+        if trace:
+            marker["trace"] = trace
+        atomic_write_json(os.path.join(farm_dir, COMPLETE_NAME), marker)
         atomic_write_json(self.state_path, {
             "version": 1, "generation": int(generation), "inflight": None})
+        self.metrics.counter(
+            "recert_generations_total",
+            help="completed generations by verdict status",
+        ).inc(status=str(verdict["status"]))
+        if seconds is not None:
+            self.metrics.histogram(
+                "recert_generation_seconds",
+                help="submit-to-verdict wall seconds per generation",
+            ).observe(seconds)
+        fields = {"generation": int(generation), "status": verdict["status"],
+                  "measured": len(measured), "holes": len(holes)}
+        if seconds is not None:
+            fields["seconds"] = seconds
+        if trace:
+            fields["trace"] = trace
+        self._record("recert.generation", **fields)
+        self.metrics.dump(os.path.join(self.recert_dir, "metrics.json"))
         return verdict
 
     def latest_completed(self) -> Tuple[int, str]:

@@ -43,12 +43,18 @@ class PendingRequest:
     waking the waiter claim first, then `deliver()`."""
 
     __slots__ = ("image", "enqueued", "deadline", "done", "result",
-                 "redispatched", "_claim_lock", "_claimed")
+                 "redispatched", "trace_id", "_claim_lock", "_claimed")
 
-    def __init__(self, image, enqueued: float, deadline: float):
+    def __init__(self, image, enqueued: float, deadline: float,
+                 trace_id: str = ""):
         self.image = image
         self.enqueued = enqueued
         self.deadline = deadline
+        # ingress correlation id: minted once in `predict()` and carried
+        # through dispatch, failover re-dispatch, and every telemetry
+        # record this request touches — the SAME id survives a re-enqueue
+        # because the request object itself does
+        self.trace_id = trace_id
         self.done = threading.Event()
         self.result = None
         # set by the supervisor on failover: at most ONE re-enqueue per

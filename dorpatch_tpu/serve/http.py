@@ -5,14 +5,24 @@ Endpoints:
 - `POST /predict` — body `{"image": [[[...]]], "deadline_ms": 250}` (HWC
   float nested lists in [0, 1]; `deadline_ms` optional). Answers the typed
   response as JSON with the status-code mapping in `types.HTTP_STATUS`
-  (200 ok / 503 overloaded / 504 deadline_exceeded / 400 error).
+  (200 ok / 503 overloaded / 504 deadline_exceeded / 400 error). A caller
+  may pin the request's correlation id via the `X-Trace-Id` header (or a
+  `trace_id` body field); otherwise one is minted here at ingress. Either
+  way the id comes back in the JSON payload and the `X-Trace-Id` response
+  header, and every telemetry record the request touches carries it.
 - `GET /healthz` — liveness + warmup state.
 - `GET /stats`   — the service's live counters, latency percentiles,
   queue depth, and per-program trace counts.
+- `GET /metrics` — Prometheus text exposition of the service's metric
+  registry (the same registry `/stats` summarizes — one source of truth).
 - `GET /robustness` — the recert verdict snapshot loaded at boot
   (gate mode, per-cell status, generation, worst margin); status 200
   when the verdict is `ok`, 503 when failing/stale/absent so a canary
   gate can probe it like a health check.
+- `POST /profile` — on-demand bounded `jax.profiler` capture into the run
+  dir (body `{"duration_ms": 500}` optional); 200 with the trace dir on
+  success, 409 while another capture is in flight, 400 when the service
+  has no results dir to write into. Serving keeps answering throughout.
 
 One handler thread per connection (`ThreadingHTTPServer`); every thread
 funnels into the same `service.predict`, so the micro-batcher — not the
@@ -35,10 +45,21 @@ class _Handler(BaseHTTPRequestHandler):
     # set per-server via the factory in HttpFrontend
     service = None
 
-    def _send_json(self, code: int, payload: dict) -> None:
+    def _send_json(self, code: int, payload: dict, headers=()) -> None:
         body = json.dumps(payload).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for name, value in headers:
+            self.send_header(name, value)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code: int, text: str) -> None:
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -49,6 +70,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200 if h["status"] == "ok" else 503, h)
         elif self.path == "/stats":
             self._send_json(200, self.service.stats())
+        elif self.path == "/metrics":
+            self._send_text(200, self.service.metrics.render_text())
         elif self.path == "/robustness":
             r = self.service.robustness()
             # canary-probe contract: 200 only on a clean verdict, 503 on
@@ -60,6 +83,9 @@ class _Handler(BaseHTTPRequestHandler):
                                   "reason": f"no route {self.path}"})
 
     def do_POST(self) -> None:  # noqa: N802 — http.server contract
+        if self.path == "/profile":
+            self._do_profile()
+            return
         if self.path != "/predict":
             self._send_json(404, {"status": "error",
                                   "reason": f"no route {self.path}"})
@@ -78,8 +104,42 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"status": "error",
                                   "reason": f"bad request body: {e!r}"})
             return
-        resp = self.service.predict(image, deadline_ms=deadline_ms)
-        self._send_json(HTTP_STATUS.get(resp.status, 500), resp.to_dict())
+        # correlation id: caller-pinned (header wins over body field) or
+        # minted HERE — ingress is where a trace id is born, so a socket
+        # client can join its own logs against the server's telemetry
+        trace_id = str(self.headers.get("X-Trace-Id", "")
+                       or payload.get("trace_id", "")
+                       or observe.new_trace_id())
+        resp = self.service.predict(image, deadline_ms=deadline_ms,
+                                    trace_id=trace_id)
+        body = resp.to_dict()
+        body["trace_id"] = trace_id
+        self._send_json(HTTP_STATUS.get(resp.status, 500), body,
+                        headers=(("X-Trace-Id", trace_id),))
+
+    def _do_profile(self) -> None:
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            duration_ms = float(payload.get("duration_ms", 500.0)) \
+                if isinstance(payload, dict) else 500.0
+        except (ValueError, TypeError) as e:
+            self._send_json(400, {"status": "error",
+                                  "reason": f"bad request body: {e!r}"})
+            return
+        if not getattr(self.service, "result_dir", None):
+            self._send_json(400, {
+                "status": "error",
+                "reason": "service has no results dir to capture into"})
+            return
+        trace_dir = self.service.capture_profile(duration_ms=duration_ms)
+        if trace_dir is None:
+            # the profiler is a process-global toggle: one at a time
+            self._send_json(409, {"status": "busy",
+                                  "reason": "a capture is already running"})
+            return
+        self._send_json(200, {"status": "ok", "dir": trace_dir,
+                              "duration_ms": duration_ms})
 
     def log_message(self, fmt: str, *args) -> None:
         # route through observe (rule DP101: no bare prints); request-level
@@ -104,7 +164,8 @@ class HttpFrontend:
                                         name="serve-http", daemon=True)
         self._thread.start()
         observe.log(f"serve: http front-end on {self.host}:{self.port} "
-                    f"(/predict /healthz /stats /robustness)")
+                    f"(/predict /profile /healthz /stats /metrics "
+                    f"/robustness)")
         return self
 
     def stop(self) -> None:

@@ -94,13 +94,9 @@ class Replica:
         self.fail_kind: Optional[str] = None
         self.fail_error: Optional[str] = None
         self.restart_at: Optional[float] = None
-        # per-replica accounting (the pool's /stats and report rows)
-        self.batches = 0
-        self.batch_images = 0
-        self.batch_slots = 0
-        self.completed = 0
-        self.duplicates_shed = 0
-        self.latencies_ms: List[float] = []
+        # per-replica accounting lives in the service's metric registry
+        # (`serve_replica_*_total{replica=...}` series), not here: one
+        # registry feeds /stats, /metrics, and the report CLI identically
 
     def begin_batch(self, reqs: List[Any]) -> None:
         with self.lock:
@@ -136,8 +132,6 @@ class ReplicaPool:
         self._stop_evt = threading.Event()
         self._supervisor: Optional[threading.Thread] = None
         self._base_depth = service.batcher.max_queue_depth
-        self.redispatched = 0
-        self.duplicates_shed = 0
         # staleness threshold: a healthy batch must finish well inside the
         # request deadline (the batcher flushes at flush_fraction of it),
         # so a replica silent for a full deadline is stuck, not slow
@@ -145,6 +139,25 @@ class ReplicaPool:
         self.stale_after_s = (stale if stale > 0.0
                               else max(self.cfg.deadline_ms / 1e3, 0.5))
         self.poll_s = max(0.05, self.stale_after_s / 4.0)
+
+    # failover totals live in the service registry (single source of truth
+    # for /stats, /metrics, and the report CLI); these properties keep the
+    # pool's historical read surface
+    @property
+    def redispatched(self) -> int:
+        return int(self.svc.metrics.value("serve_failover_redispatched_total"))
+
+    @property
+    def duplicates_shed(self) -> int:
+        return int(self.svc.metrics.value("serve_duplicates_shed_total"))
+
+    def _replica_event(self, event: str, r: Replica) -> None:
+        """Lifecycle tally: `serve_replica_events_total{event,replica}` —
+        the counted twin of the `serve.replica.*` event-log records."""
+        self.svc.metrics.counter(
+            "serve_replica_events_total",
+            help="replica lifecycle transitions by kind",
+        ).inc(event=event, replica=str(r.slot))
 
     # ---------------- lifecycle ----------------
 
@@ -171,6 +184,7 @@ class ReplicaPool:
                         aot_stats=aot_stats))
         for r in self.replicas:
             self._launch(r)
+            self._replica_event("start", r)
             observe.record_event("serve.replica.start", replica=r.slot,
                                  generation=r.generation,
                                  aot=bool(r.aot_stats))
@@ -290,9 +304,9 @@ class ReplicaPool:
         won = [r for r in reqs if r.claim()]
         for r in won:
             observe.record_event("serve.request", status="internal_error",
-                                 latency_s=round(now - r.enqueued, 6))
-        with self.svc._lock:
-            self.svc._counts["errors"] += len(won)
+                                 latency_s=round(now - r.enqueued, 6),
+                                 trace=r.trace_id)
+        self.svc._m_requests.inc(len(won), status="internal_error")
         for r in won:
             r.deliver(ServeError(reason=reason,
                                  latency_ms=(now - r.enqueued) * 1e3,
@@ -335,9 +349,10 @@ class ReplicaPool:
         # telemetry: a throwing event sink must never strand a replica in
         # "sick" (a state this method owns) or lose its in-flight requests
         r.state = "sick"
+        self._replica_event("sick", r)
         inflight = r.take_inflight()
         self._failover(inflight, now)
-        r.restarts += 1
+        r.restarts += 1  # noqa: DP108 — control state, not a metric
         retire = r.restarts > int(getattr(self.cfg, "max_restarts", 0))
         delay = 0.0
         if not retire:
@@ -353,6 +368,7 @@ class ReplicaPool:
         if retire:
             self._retire(r)
             return
+        self._replica_event("quarantine", r)
         observe.record_event("serve.replica.quarantine", replica=r.slot,
                              generation=r.generation, cause=cause,
                              restarts=r.restarts,
@@ -370,12 +386,11 @@ class ReplicaPool:
                 continue
             if req.redispatched:
                 if req.claim():
-                    with self.svc._lock:
-                        self.svc._counts["errors"] += 1
+                    self.svc._m_requests.inc(status="internal_error")
                     observe.record_event(
                         "serve.request", status="internal_error",
                         latency_s=round(now - req.enqueued, 6),
-                        redispatched=True)
+                        redispatched=True, trace=req.trace_id)
                     req.deliver(ServeError(
                         reason="replica failed twice",
                         latency_ms=(now - req.enqueued) * 1e3,
@@ -383,11 +398,11 @@ class ReplicaPool:
                 continue
             if now > req.deadline:
                 if req.claim():
-                    with self.svc._lock:
-                        self.svc._counts["deadline_exceeded"] += 1
+                    self.svc._m_requests.inc(status="deadline_exceeded")
                     observe.record_event(
                         "serve.request", status="deadline_exceeded",
-                        latency_s=round(now - req.enqueued, 6), shed=True)
+                        latency_s=round(now - req.enqueued, 6), shed=True,
+                        trace=req.trace_id)
                     req.deliver(DeadlineExceeded(
                         latency_ms=(now - req.enqueued) * 1e3,
                         deadline_ms=req.budget_s() * 1e3))
@@ -395,8 +410,10 @@ class ReplicaPool:
             req.redispatched = True
             requeue.append(req)
         if requeue:
-            with self._lock:
-                self.redispatched += len(requeue)
+            self.svc.metrics.counter(
+                "serve_failover_redispatched_total",
+                help="in-flight requests re-enqueued after replica failure",
+            ).inc(len(requeue))
             if not self.batcher.requeue(requeue):
                 self._reject_all(requeue, "service stopping")
 
@@ -410,6 +427,7 @@ class ReplicaPool:
         new_depth = (max(1, self._base_depth * live // total)
                      if live else 0)
         self.batcher.set_max_queue_depth(new_depth)
+        self._replica_event("retire", r)
         observe.record_event("serve.replica.retire", replica=r.slot,
                              generation=r.generation, restarts=r.restarts,
                              healthy_left=healthy,
@@ -428,7 +446,8 @@ class ReplicaPool:
                                  generation=r.generation,
                                  cause="restart_failed", error=repr(e),
                                  restarts=r.restarts)
-            r.restarts += 1
+            self._replica_event("quarantine", r)
+            r.restarts += 1  # noqa: DP108 — control state, not a metric
             if r.restarts > int(getattr(self.cfg, "max_restarts", 0)):
                 self._retire(r)
             else:
@@ -441,7 +460,7 @@ class ReplicaPool:
                 r.restart_at = self._clock() + delay
                 r.state = "quarantined"
             return
-        r.generation += 1
+        r.generation += 1  # noqa: DP108 — control state, not a metric
         r.clean, r.defenses = clean, defenses
         r.aot_stats = aot_stats
         r.hb = ReplicaHeartbeat(self._hb_path(r.slot), r.slot, self._clock)
@@ -453,6 +472,7 @@ class ReplicaPool:
             self.svc._clean, self.svc.defenses = clean, defenses
         r.state = "healthy"
         self._launch(r)
+        self._replica_event("restart", r)
         observe.record_event(
             "serve.replica.restart", replica=r.slot,
             generation=r.generation, restarts=r.restarts,
@@ -466,14 +486,17 @@ class ReplicaPool:
 
     def snapshot(self) -> List[dict]:
         now = self._clock()
+        m = self.svc.metrics
         out = []
         for r in self.replicas:
-            lats = sorted(r.latencies_ms[-8192:])
+            rl = str(r.slot)
 
-            def pct(q, lats=lats):
-                v = observe.nearest_rank_percentile(lats, q)
+            def pct(q, rl=rl):
+                v = m.percentile("serve_replica_latency_ms", q, replica=rl)
                 return None if v is None else round(v, 3)
 
+            images = m.value("serve_replica_batch_images_total", replica=rl)
+            slots = m.value("serve_replica_batch_slots_total", replica=rl)
             out.append({
                 "replica": r.slot,
                 "state": r.state,
@@ -482,11 +505,13 @@ class ReplicaPool:
                 "thread_alive": r.thread_alive(),
                 "last_phase": r.hb.last_phase,
                 "stale_s": round(r.hb.stale_s(now), 3),
-                "batches": r.batches,
-                "completed": r.completed,
-                "duplicates_shed": r.duplicates_shed,
-                "occupancy": (round(r.batch_images / r.batch_slots, 4)
-                              if r.batch_slots else 0.0),
+                "batches": int(m.value("serve_replica_batches_total",
+                                       replica=rl)),
+                "completed": int(m.value("serve_replica_completed_total",
+                                         replica=rl)),
+                "duplicates_shed": int(m.value(
+                    "serve_replica_duplicates_shed_total", replica=rl)),
+                "occupancy": (round(images / slots, 4) if slots else 0.0),
                 "latency_ms": {"p50": pct(0.50), "p95": pct(0.95)},
                 "trace_counts": sum(self.svc._bank_trace_counts(
                     r.clean, r.defenses).values()),

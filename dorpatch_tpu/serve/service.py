@@ -197,13 +197,45 @@ class CertifiedInferenceService:
                             if self.defenses else "off")
 
         self._lock = threading.Lock()
-        self._counts = {"received": 0, "completed": 0, "rejected": 0,
-                        "deadline_exceeded": 0, "errors": 0, "batches": 0,
-                        "batch_images": 0, "batch_slots": 0,
-                        "certify_forwards": 0,
-                        "certify_forward_equivalents": 0.0,
-                        "certify_forwards_exhaustive": 0}
-        self._latencies_ms: List[float] = []
+        # ONE typed registry for every piece of serving accounting: the
+        # `/stats` block, `GET /metrics`, the report CLI, bench rows, and
+        # the loadgen reconciliation all render from these series — there
+        # is no second ledger to drift from (DP108 enforces this).
+        self.metrics = observe.MetricRegistry()
+        m = self.metrics
+        self._m_requests = m.counter(
+            "serve_requests_total",
+            "terminal request outcomes by status")
+        self._m_received = m.counter(
+            "serve_received_total", "requests admitted to the queue")
+        self._m_batches = m.counter(
+            "serve_batches_total", "dispatched micro-batches")
+        self._m_batch_images = m.counter(
+            "serve_batch_images_total", "images across dispatched batches")
+        self._m_batch_slots = m.counter(
+            "serve_batch_slots_total",
+            "padded bucket slots across dispatched batches")
+        self._m_certify_fwd = m.counter(
+            "serve_certify_forwards_total",
+            "model forwards spent on certification")
+        self._m_certify_exh = m.counter(
+            "serve_certify_forwards_exhaustive_total",
+            "forwards an exhaustive double-masking pass would have spent")
+        self._m_certify_fe = m.counter(
+            "serve_certify_forward_equivalents_total",
+            "fractional full-forward equivalents (incremental engines)")
+        self._m_latency = m.histogram(
+            "serve_latency_ms", "end-to-end latency of ok requests (ms)")
+        self._m_replica_latency = m.histogram(
+            "serve_replica_latency_ms",
+            "per-replica batch-completion latency of ok requests (ms)")
+        self._m_replica_events = m.counter(
+            "serve_replica_events_total",
+            "replica lifecycle transitions by event")
+        # computed gauge: reads the batcher at exposition time, so the
+        # admit path pays zero extra bookkeeping
+        m.gauge("serve_queue_depth", "live batcher queue depth"
+                ).set_function(lambda: float(self.batcher.qsize()))
         self._pool: Optional[ReplicaPool] = None
         self._stack: Optional[contextlib.ExitStack] = None
         self._elog: Optional[observe.EventLog] = None
@@ -372,13 +404,30 @@ class CertifiedInferenceService:
             observe.log(f"WARNING: serve workers still draining after "
                         f"{drain_s:.1f}s; telemetry stays open",
                         file=sys.stderr)
+            if self.result_dir:
+                # the books still land on disk: a wedged shutdown must not
+                # cost the fleet cross-check its server snapshot (the
+                # clean-join path below overwrites with the final dump)
+                self.metrics.dump(
+                    os.path.join(self.result_dir, "metrics.json"))
             return
         self._pool = None
         observe.record_event("serve.stopped", **self._snapshot())
+        if self.result_dir:
+            # final atomic snapshot next to events.jsonl: the offline
+            # report and the fleet cross-check read this file
+            self.metrics.dump(os.path.join(self.result_dir, "metrics.json"))
         if self._stack is not None:
             self._stack.close()
             self._stack = None
             self._elog = None
+
+    def capture_profile(self, duration_ms: float = 500.0) -> Optional[str]:
+        """On-demand bounded `jax.profiler` capture into the run dir (the
+        `POST /profile` hook). None when no result_dir is configured or a
+        capture is already running."""
+        return observe.capture_profile(self.result_dir,
+                                       duration_s=float(duration_ms) / 1e3)
 
     def __enter__(self) -> "CertifiedInferenceService":
         return self.start()
@@ -535,27 +584,28 @@ class CertifiedInferenceService:
 
     # ---------------- client API ----------------
 
-    def predict(self, image, deadline_ms: Optional[float] = None):
+    def predict(self, image, deadline_ms: Optional[float] = None,
+                trace_id: str = ""):
         """Certified prediction for ONE image (HWC float in [0, 1]).
         Returns a typed response: `PredictResult`, `Overloaded`,
         `DeadlineExceeded`, or `ServeError`. Thread-safe; this is the same
-        path the HTTP front-end drives."""
+        path the HTTP front-end drives. `trace_id` correlates this request
+        across processes (minted here when the ingress didn't)."""
+        tid = str(trace_id) if trace_id else observe.new_trace_id()
         try:
             # noqa-reason: parses the client's HOST-side nested list/array;
             # no device value can reach this path
             arr = np.asarray(image, dtype=np.float32)  # noqa: DP107
         except (ValueError, TypeError) as e:  # ragged / non-numeric input
-            with self._lock:
-                self._counts["errors"] += 1
+            self._m_requests.inc(status="error")
             observe.record_event("serve.request", status="error",
-                                 reason="bad_image")
+                                 reason="bad_image", trace=tid)
             return ServeError(reason=f"image does not parse: {e}")
         want = (self.img_size, self.img_size, 3)
         if arr.shape != want:
-            with self._lock:
-                self._counts["errors"] += 1
+            self._m_requests.inc(status="error")
             observe.record_event("serve.request", status="error",
-                                 reason="bad_shape")
+                                 reason="bad_shape", trace=tid)
             return ServeError(reason=f"image shape {arr.shape} != {want}")
         if deadline_ms is not None and not (
                 isinstance(deadline_ms, (int, float))
@@ -563,29 +613,31 @@ class CertifiedInferenceService:
             # Infinity/NaN parse as legal JSON floats but would poison the
             # batcher's flush-instant arithmetic (inf wait / NaN min) —
             # one bad request must never wedge the worker
-            with self._lock:
-                self._counts["errors"] += 1
+            self._m_requests.inc(status="error")
             observe.record_event("serve.request", status="error",
-                                 reason="bad_deadline")
+                                 reason="bad_deadline", trace=tid)
             return ServeError(
                 reason=f"deadline_ms must be a finite positive number, "
                        f"got {deadline_ms!r}")
         now = self._clock()
         budget_s = (deadline_ms if deadline_ms is not None
                     else self.serve_cfg.deadline_ms) / 1e3
-        req = PendingRequest(arr, enqueued=now, deadline=now + budget_s)
+        req = PendingRequest(arr, enqueued=now, deadline=now + budget_s,
+                             trace_id=tid)
         if not self.batcher.submit(req):
             depth = self.batcher.qsize()
-            with self._lock:
-                self._counts["rejected"] += 1
+            self._m_requests.inc(status="overloaded")
             # event status matches the client-visible response status, so
             # loadgen's by_status and the report's agree on the same run
             observe.record_event("serve.request", status="overloaded",
-                                 queue_depth=depth)
+                                 queue_depth=depth, trace=tid)
             return Overloaded(queue_depth=depth,
                               limit=self.batcher.max_queue_depth)
-        with self._lock:
-            self._counts["received"] += 1
+        self._m_received.inc()
+        # `opens_trace`: the fleet report joins on these — an admitted
+        # trace with no later terminal record is an orphaned request
+        observe.record_event("serve.admit", trace=tid, opens_trace=True,
+                             queue_depth=self.batcher.qsize())
         # every admitted request IS resolved (the worker sheds expired ones
         # with DeadlineExceeded, the supervisor re-dispatches a failed
         # replica's in-flight work), so wait for the answer and poll only
@@ -603,8 +655,10 @@ class CertifiedInferenceService:
             pool = self._pool
             if pool is None or not pool.serving_possible():
                 if req.claim():
-                    with self._lock:
-                        self._counts["errors"] += 1
+                    self._m_requests.inc(status="internal_error")
+                    observe.record_event(
+                        "serve.request", status="internal_error",
+                        reason="worker thread died", trace=tid)
                     req.deliver(ServeError(reason="worker thread died",
                                            status="internal_error"))
                     return req.result
@@ -612,12 +666,11 @@ class CertifiedInferenceService:
                     2.0 * pool.stale_after_s, 5.0):
                 if req.claim():
                     now2 = self._clock()
-                    with self._lock:
-                        self._counts["deadline_exceeded"] += 1
+                    self._m_requests.inc(status="deadline_exceeded")
                     observe.record_event(
                         "serve.request", status="deadline_exceeded",
                         latency_s=round(now2 - req.enqueued, 6),
-                        abandoned=True)
+                        abandoned=True, trace=tid)
                     req.deliver(DeadlineExceeded(
                         latency_ms=(now2 - req.enqueued) * 1e3,
                         deadline_ms=req.budget_s() * 1e3))
@@ -675,9 +728,25 @@ class CertifiedInferenceService:
         return s
 
     def _snapshot(self) -> dict:
-        with self._lock:
-            s = dict(self._counts)
-            lats = sorted(self._latencies_ms)
+        # every number here is a registry read — /stats is a VIEW over the
+        # same series `GET /metrics` exposes, never a second ledger
+        v = self.metrics.value
+        completed = int(v("serve_requests_total", status="ok"))
+        # "errors" folds both error classes the old ledger lumped together:
+        # client-fault `error` and service-fault `internal_error`
+        s = {
+            "received": int(v("serve_received_total")),
+            "completed": completed,
+            "rejected": int(v("serve_requests_total", status="overloaded")),
+            "deadline_exceeded": int(
+                v("serve_requests_total", status="deadline_exceeded")),
+            "errors": int(v("serve_requests_total", status="error")
+                          + v("serve_requests_total",
+                              status="internal_error")),
+            "batches": int(v("serve_batches_total")),
+            "batch_images": int(v("serve_batch_images_total")),
+            "batch_slots": int(v("serve_batch_slots_total")),
+        }
         s["occupancy"] = (round(s["batch_images"] / s["batch_slots"], 4)
                           if s["batch_slots"] else 0.0)
         # certification-cost summary: mean evaluated masked-table entries
@@ -686,16 +755,16 @@ class CertifiedInferenceService:
         # scheduler skipped (0.0 when prune=off)
         s["prune"] = self.prune
         s["incremental"] = self.incremental
-        fwd, exh = s.pop("certify_forwards"), \
-            s.pop("certify_forwards_exhaustive")
-        fe = s.pop("certify_forward_equivalents")
+        fwd = int(v("serve_certify_forwards_total"))
+        exh = int(v("serve_certify_forwards_exhaustive_total"))
+        fe = float(v("serve_certify_forward_equivalents_total"))
         s["certify_forwards"] = {
             "total": fwd,
-            "per_request": round(fwd / s["completed"], 1)
-            if s["completed"] else None,
+            "per_request": round(fwd / completed, 1)
+            if completed else None,
             "forward_equivalents": round(fe, 2),
-            "forward_equivalents_per_request": round(fe / s["completed"], 2)
-            if s["completed"] else None,
+            "forward_equivalents_per_request": round(fe / completed, 2)
+            if completed else None,
             "prune_rate": round(1.0 - fwd / exh, 4) if exh else None,
             "speedup_equivalent": round(exh / fe, 2) if fe else None,
         }
@@ -705,12 +774,14 @@ class CertifiedInferenceService:
         total = (s["completed"] + s["rejected"] + s["deadline_exceeded"]
                  + s["errors"])
         s["reject_rate"] = round(s["rejected"] / total, 4) if total else 0.0
-        def pct(q):
-            v = observe.nearest_rank_percentile(lats, q)
-            return None if v is None else round(v, 3)
 
-        s["latency_ms"] = {"count": len(lats), "p50": pct(0.50),
-                           "p95": pct(0.95), "p99": pct(0.99)}
+        def pct(q):
+            p = self._m_latency.percentile(q)
+            return None if p is None else round(p, 3)
+
+        s["latency_ms"] = {"count": self._m_latency.count(),
+                           "p50": pct(0.50), "p95": pct(0.95),
+                           "p99": pct(0.99)}
         return s
 
     # ---------------- worker ----------------
@@ -745,12 +816,10 @@ class CertifiedInferenceService:
         """A resolver lost the claim race: the request was already answered
         elsewhere (failover re-dispatch landed first, or vice versa). The
         late answer is shed, counted, and never delivered."""
-        pool = self._pool
-        if pool is not None:
-            with pool._lock:
-                pool.duplicates_shed += 1
+        self.metrics.counter("serve_duplicates_shed_total").inc()
         if replica is not None:
-            replica.duplicates_shed += 1
+            self.metrics.counter("serve_replica_duplicates_shed_total").inc(
+                replica=str(replica.slot))
 
     def _fail_batch(self, batch: List[PendingRequest], e: Exception,
                     replica=None) -> None:
@@ -765,9 +834,8 @@ class CertifiedInferenceService:
         for r in pending:
             observe.record_event(
                 "serve.request", status="internal_error",
-                latency_s=round(now - r.enqueued, 6))
-        with self._lock:
-            self._counts["errors"] += len(pending)
+                latency_s=round(now - r.enqueued, 6), trace=r.trace_id)
+        self._m_requests.inc(len(pending), status="internal_error")
         observe.record_event(
             "serve.batch_error", error=repr(e), images=len(pending),
             replica=replica.slot if replica is not None else 0)
@@ -794,9 +862,8 @@ class CertifiedInferenceService:
                 observe.record_event("serve.request",
                                      status="deadline_exceeded",
                                      latency_s=round(now - r.enqueued, 6),
-                                     shed=True)
-            with self._lock:
-                self._counts["deadline_exceeded"] += len(won)
+                                     shed=True, trace=r.trace_id)
+            self._m_requests.inc(len(won), status="deadline_exceeded")
             for r in won:
                 r.deliver(DeadlineExceeded(
                     latency_ms=(now - r.enqueued) * 1e3,
@@ -808,7 +875,8 @@ class CertifiedInferenceService:
         bucket = data_lib.bucket_batch(n, self.bucket_sizes)
         with observe.span("serve.batch", bucket=int(bucket), images=n,
                           replica=slot,
-                          queue_depth=self.batcher.qsize()) as sp:
+                          queue_depth=self.batcher.qsize(),
+                          traces=[r.trace_id for r in reqs]) as sp:
             # pad on the host so exactly ONE host->device transfer
             # happens per batch, always bucket-shaped
             imgs = data_lib.pad_to_bucket(np.stack([r.image for r in reqs]),
@@ -847,39 +915,39 @@ class CertifiedInferenceService:
                         extra["forward_equivalents"] = round(float(fe), 2)
                 observe.record_event("serve.request", status=status,
                                      latency_s=round((lat or 0.0) / 1e3, 6),
-                                     bucket=int(bucket), **extra)
-                with self._lock:
-                    if status == "ok":
-                        ok += 1
-                        self._counts["completed"] += 1
-                        if fwd is not None:
-                            self._counts["certify_forwards"] += int(fwd)
-                            self._counts["certify_forwards_exhaustive"] += \
-                                exhaustive
-                        if fe is not None:
-                            self._counts["certify_forward_equivalents"] += \
-                                float(fe)
-                        self._latencies_ms.append(lat)
-                        if len(self._latencies_ms) > 8192:
-                            del self._latencies_ms[:4096]
-                    elif status == "deadline_exceeded":
-                        self._counts["deadline_exceeded"] += 1
-                    else:
-                        self._counts["errors"] += 1
-            with self._lock:
-                self._counts["batches"] += 1
-                self._counts["batch_images"] += n
-                self._counts["batch_slots"] += bucket
+                                     bucket=int(bucket), trace=r.trace_id,
+                                     **extra)
+                if status == "ok":
+                    ok += 1
+                    self._m_requests.inc(status="ok")
+                    if fwd is not None:
+                        self._m_certify_fwd.inc(int(fwd))
+                        self._m_certify_exh.inc(exhaustive)
+                    if fe is not None:
+                        self._m_certify_fe.inc(float(fe))
+                    self._m_latency.observe(lat)
+                else:
+                    # deadline_exceeded / error / internal_error: count
+                    # under the SAME status string the client response and
+                    # the event carry, so all three surfaces reconcile
+                    self._m_requests.inc(status=status)
+            self._m_batches.inc()
+            self._m_batch_images.inc(n)
+            self._m_batch_slots.inc(bucket)
             if replica is not None:
-                replica.batches += 1
-                replica.batch_images += n
-                replica.batch_slots += bucket
-                replica.completed += ok
-                replica.latencies_ms.extend(
-                    resp.latency_ms for _r, resp in deliver
-                    if resp.status == "ok")
-                if len(replica.latencies_ms) > 8192:
-                    del replica.latencies_ms[:4096]
+                rl = str(replica.slot)
+                self.metrics.counter("serve_replica_batches_total").inc(
+                    replica=rl)
+                self.metrics.counter("serve_replica_batch_images_total").inc(
+                    n, replica=rl)
+                self.metrics.counter("serve_replica_batch_slots_total").inc(
+                    bucket, replica=rl)
+                self.metrics.counter("serve_replica_completed_total").inc(
+                    ok, replica=rl)
+                for _r, resp in deliver:
+                    if resp.status == "ok":
+                        self._m_replica_latency.observe(resp.latency_ms,
+                                                        replica=rl)
             sp["ok"] = ok
             for r, resp in deliver:
                 r.deliver(resp)

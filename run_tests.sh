@@ -4,7 +4,7 @@
 # behind any other live JAX process); tests run on an 8-device virtual CPU
 # mesh regardless (tests/conftest.py).
 cd "$(dirname "$0")"
-# Gate 1: the JAX-aware static-analysis rules (DP101-DP107) over the package
+# Gate 1: the JAX-aware static-analysis rules (DP101-DP108) over the package
 # and tools — pure ast/tokenize logic, never initializes a jax backend,
 # fails on any finding.
 python -m dorpatch_tpu.analysis dorpatch_tpu tools || exit $?
@@ -158,3 +158,16 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   | grep -q '"ok": true' \
   || { echo "recert smoke: re-certification/gate violation"; exit 1; }
 echo "recert smoke: OK"
+# Smoke: the fleet metrics plane — a 2-replica service under closed-loop
+# load (unfaulted AND with chaos wedging replica 0 mid-batch) must keep
+# the client-side attempt counts and the server's serve_requests_total
+# series equal BIT-FOR-BIT (exactly-once across failover re-dispatch),
+# the Prometheus text exposition must round-trip to the same numbers,
+# and `observe.report --fleet` must join the run dirs on trace ids with
+# ZERO orphans and a consistent verdict (tools/metrics_smoke.py exits
+# non-zero and lists the violations otherwise).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/metrics_smoke.py \
+  | grep -q '"ok": true' \
+  || { echo "metrics smoke: client/server reconciliation violation"; exit 1; }
+echo "metrics smoke: OK"

@@ -1,0 +1,29 @@
+"""DP108 negatives: accounting through the registry, local loop
+bookkeeping, and reasoned control-state escapes (linted as
+dorpatch_tpu/serve/worker.py)."""
+
+from dorpatch_tpu import observe
+
+
+class Batcher:
+    def __init__(self, metrics: observe.MetricRegistry):
+        self.metrics = metrics
+        self.restarts = 0
+
+    def account(self, reqs, status):
+        # the sanctioned spelling: one registry, one set of books
+        self.metrics.counter("serve_requests_total").inc(
+            len(reqs), status=status)
+
+    def drain(self, batches):
+        done = 0                          # plain local: loop bookkeeping
+        counts = {}
+        for batch in batches:
+            done += 1
+            counts[batch.status] = counts.get(batch.status, 0)
+            counts[batch.status] += 1     # Name-rooted subscript: local dict
+        return done, counts
+
+    def restart(self):
+        # genuine control state (supervisor decision input, not telemetry)
+        self.restarts += 1  # noqa: DP108 — restart budget, not a metric

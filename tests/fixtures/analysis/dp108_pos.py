@@ -1,0 +1,16 @@
+"""DP108 positives: hand-rolled counter/gauge state mutated in a serve/
+worker path instead of the observe.metrics registry (linted as
+dorpatch_tpu/serve/worker.py)."""
+
+
+class Batcher:
+    def __init__(self):
+        self.completed = 0
+        self.depth = 0
+        self._counts = {}
+
+    def account(self, reqs, status):
+        self.completed += 1               # <- DP108: shadow counter
+        self._counts[status] += 1         # <- DP108: attr-rooted tally dict
+        self.depth -= len(reqs)           # <- DP108: shadow gauge
+        return self.completed

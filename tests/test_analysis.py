@@ -25,15 +25,18 @@ from dorpatch_tpu.analysis.cli import main as cli_main
 REPO = pathlib.Path(__file__).resolve().parents[1]
 FIXTURES = REPO / "tests" / "fixtures" / "analysis"
 
-RULE_IDS = ("DP101", "DP102", "DP103", "DP104", "DP105", "DP106", "DP107")
+RULE_IDS = ("DP101", "DP102", "DP103", "DP104", "DP105", "DP106", "DP107",
+            "DP108")
 
 
 def run_fixture(name: str, rule_id: str):
     """Lint one fixture as if it lived at dorpatch_tpu/<name>, keeping only
     the rule under test (fixtures legitimately trip other rules: e.g. the
-    DP102 positives use undecorated prints of their own). DP107 fixtures
-    lint as serve/ files (the rule is scoped to that subpackage)."""
-    logical = (f"dorpatch_tpu/serve/{name}" if name.startswith("dp107")
+    DP102 positives use undecorated prints of their own). DP107/DP108
+    fixtures lint as serve/ files (those rules are scoped to the serving
+    subpackages)."""
+    logical = (f"dorpatch_tpu/serve/{name}"
+               if name.startswith(("dp107", "dp108"))
                else f"dorpatch_tpu/{name}")
     findings = analyze_file(FIXTURES / name, logical_path=logical)
     return [f for f in findings if f.rule_id == rule_id]
@@ -113,6 +116,48 @@ def test_dp107_catches_each_sync_kind():
 def test_dp107_scoped_to_serve_subpackage(logical):
     findings = analyze_file(FIXTURES / "dp107_pos.py", logical_path=logical)
     assert not [f for f in findings if f.rule_id == "DP107"]
+
+
+def test_dp108_counts_each_mutation_kind():
+    found = run_fixture("dp108_pos.py", "DP108")
+    assert len(found) == 3, [f.render() for f in found]
+    msgs = " | ".join(f.message for f in found)
+    assert "<obj>.completed +=" in msgs
+    assert "<obj>._counts[...] +=" in msgs
+    assert "<obj>.depth -=" in msgs
+
+
+@pytest.mark.parametrize("logical", [
+    "dorpatch_tpu/farm/worker.py",   # farm/ is in scope too
+    "dorpatch_tpu/serve/pool.py",
+])
+def test_dp108_fires_across_serving_subpackages(logical):
+    findings = analyze_file(FIXTURES / "dp108_pos.py", logical_path=logical)
+    assert [f.rule_id for f in findings if f.rule_id == "DP108"] \
+        == ["DP108"] * 3
+
+
+@pytest.mark.parametrize("logical", [
+    "dorpatch_tpu/attack.py",        # outside serve//farm/: not counters'
+    "tools/serve/loadgen.py",        # tools tree is never package scope
+    "tests/serve/test_worker.py",    # test tree exempt
+])
+def test_dp108_scoped_to_serving_subpackages(logical):
+    findings = analyze_file(FIXTURES / "dp108_pos.py", logical_path=logical)
+    assert not [f for f in findings if f.rule_id == "DP108"]
+
+
+def test_dp108_name_rooted_subscript_exempt():
+    """`counts[k] += 1` on a plain local stays exempt — only attribute
+    state (`self.x += 1`, `self.d[k] += 1`) is published accounting."""
+    src = ("def drain(batches):\n"
+           "    counts = {}\n"
+           "    for b in batches:\n"
+           "        counts[b.status] = counts.get(b.status, 0)\n"
+           "        counts[b.status] += 1\n"
+           "    return counts\n")
+    assert analyze_source(src, logical_path="dorpatch_tpu/farm/queue.py",
+                          select=["DP108"]) == []
 
 
 def test_dp107_nested_def_inside_marshal_is_exempt():

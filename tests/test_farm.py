@@ -227,6 +227,64 @@ def test_lease_skew_future_heartbeat_beats_lapsed_expires_ts(tmp_path):
     assert reclaimed is not None and reclaimed["id"] == job_id
 
 
+def test_lease_seq_advance_keeps_behind_skewed_worker_alive(tmp_path):
+    """Monotonic beat `seq` is the skew-proof liveness witness: a worker
+    whose clock runs BEHIND writes beat timestamps that look stale, but
+    as long as the queue observes the seq advancing between checks the
+    lease holds — and once the seq freezes, staleness follows the queue's
+    own clock."""
+    clock = FakeClock(1000.0)
+    jq = JobQueue(str(tmp_path / "farm"), clock=clock)
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 3})
+    hb = tmp_path / "hb.jsonl"
+    hb.write_text(json.dumps({"ts": 995.0, "seq": 0, "phase": "x"}) + "\n")
+    assert jq.claim("wA", ttl=10.0, heartbeat_path=str(hb)) is not None
+    clock.now = 1005.0  # first observation: ts fallback, 10s <= ttl
+    assert jq.claim("wB", ttl=10.0) is None
+    # the worker's slow clock stamps ts=1000 while the queue reads 1012:
+    # the ts check alone would reclaim (12s > ttl), but seq advanced
+    clock.now = 1012.0
+    hb.write_text(hb.read_text()
+                  + json.dumps({"ts": 1000.0, "seq": 1, "phase": "x"}) + "\n")
+    assert jq.claim("wB", ttl=10.0) is None
+    clock.now = 1020.0
+    hb.write_text(hb.read_text()
+                  + json.dumps({"ts": 1005.0, "seq": 2, "phase": "x"}) + "\n")
+    assert jq.claim("wB", ttl=10.0) is None
+    # beats stop: the frozen seq goes stale on the QUEUE's clock
+    clock.now = 1035.0
+    reclaimed = jq.claim("wB", ttl=10.0)
+    assert reclaimed is not None and reclaimed["id"] == job_id
+    assert reclaimed["worker"] == "wB"
+
+
+def test_lease_frozen_seq_with_future_ts_goes_stale(tmp_path):
+    """A worker whose clock ran AHEAD leaves its last beat timestamp in
+    the queue's future; when it wedges, the `(now - ts) <= ttl` fallback
+    would keep the corpse alive until the skew wears off. The frozen seq
+    must win: no advance for a local TTL means reclaim, wall clocks be
+    damned."""
+    clock = FakeClock(1000.0)
+    jq = JobQueue(str(tmp_path / "farm"), clock=clock)
+    (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 3})
+    hb = tmp_path / "hb.jsonl"
+    hb.write_text(json.dumps({"ts": 1000.0, "seq": 0, "phase": "x"}) + "\n")
+    assert jq.claim("wA", ttl=10.0, heartbeat_path=str(hb)) is not None
+    clock.now = 1005.0
+    assert jq.claim("wB", ttl=10.0) is None
+    # last beat before the wedge, stamped by a 12s-fast clock
+    hb.write_text(hb.read_text()
+                  + json.dumps({"ts": 1020.0, "seq": 1, "phase": "x"}) + "\n")
+    clock.now = 1010.0  # seq advanced since the last check: alive
+    assert jq.claim("wB", ttl=10.0) is None
+    # seq frozen for > ttl on the queue's clock, yet ts=1020 still reads
+    # "5s ago" at now=1025 — the seq verdict must override it
+    clock.now = 1025.0
+    reclaimed = jq.claim("wB", ttl=10.0)
+    assert reclaimed is not None and reclaimed["id"] == job_id
+    assert reclaimed["worker"] == "wB"
+
+
 def test_corrupt_lease_is_reclaimable(tmp_path):
     jq = JobQueue(str(tmp_path / "farm"))
     (job_id,) = jq.submit_spec({"axes": {}, "max_attempts": 1})

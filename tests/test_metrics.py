@@ -1,0 +1,220 @@
+"""The typed metric registry (observe/metrics.py): percentile parity with
+the shared nearest-rank helper, concurrent-increment exactness, atomic
+snapshot dumps under fault, exposition round-trips, and trace-id
+propagation through a failover re-dispatch.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dorpatch_tpu.config import DefenseConfig, ServeConfig
+from dorpatch_tpu.observe import (
+    MetricRegistry,
+    labeled_values,
+    nearest_rank_percentile,
+    parse_exposition,
+)
+from dorpatch_tpu.observe import report as report_mod
+from dorpatch_tpu.serve.service import CertifiedInferenceService
+from dorpatch_tpu.serve.types import PredictResult
+
+IMG = 32
+N_CLASSES = 5
+
+
+def stub_apply(params, x):
+    s = x.mean(axis=(1, 2, 3))
+    return jax.nn.one_hot((s * 7).astype(jnp.int32) % N_CLASSES, N_CLASSES)
+
+
+# ---------- registry surface ----------
+
+def test_counter_gauge_histogram_basics():
+    m = MetricRegistry()
+    c = m.counter("req_total", help="requests")
+    c.inc(status="ok")
+    c.inc(2, status="ok")
+    c.inc(status="error")
+    assert m.value("req_total", status="ok") == 3
+    assert m.value("req_total", status="error") == 1
+    assert c.total() == 4
+    with pytest.raises(ValueError):
+        c.inc(-1, status="ok")
+
+    g = m.gauge("depth")
+    g.set(7)
+    assert m.value("depth") == 7
+    g.set_function(lambda: 13, kind="computed")
+    assert m.value("depth", kind="computed") == 13
+
+    h = m.histogram("lat_ms")
+    h.observe(3.0)
+    assert m.value("lat_ms") == 1  # histograms read as their count
+
+
+def test_registry_names_are_typed_and_idempotent():
+    m = MetricRegistry()
+    c = m.counter("x_total")
+    assert m.counter("x_total") is c
+    with pytest.raises(TypeError):
+        m.gauge("x_total")
+
+
+def test_histogram_percentile_matches_nearest_rank_on_random_data():
+    """The satellite contract: registry percentiles and every other
+    surface (/stats, loadgen, report CLI) answer from the SAME
+    nearest-rank formula — no interpolation drift."""
+    rng = np.random.default_rng(42)
+    m = MetricRegistry()
+    h = m.histogram("lat_ms")
+    samples = rng.lognormal(mean=3.0, sigma=1.2, size=1000).tolist()
+    for v in samples:
+        h.observe(v, path="predict")
+    for q in (0.0, 0.25, 0.5, 0.9, 0.95, 0.99, 1.0):
+        want = nearest_rank_percentile(sorted(samples), q)
+        got = m.percentile("lat_ms", q, path="predict")
+        assert got == pytest.approx(want), q
+    assert m.percentile("lat_ms", 0.5, path="missing") is None
+    assert m.percentile("nope", 0.5) is None
+
+
+def test_concurrent_increment_exactness_8_threads():
+    """8 threads x 10k increments land exactly — the one-lock-per-registry
+    contract (a lost update here forks the fleet's books)."""
+    m = MetricRegistry()
+    c = m.counter("hits_total")
+    h = m.histogram("obs_ms", buckets=(1.0, 10.0))
+    n_threads, n_iter = 8, 10_000
+    start = threading.Barrier(n_threads)
+
+    def worker(tid):
+        start.wait()
+        for i in range(n_iter):
+            c.inc(thread=str(tid % 2))
+            h.observe(float(i % 7))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.total() == n_threads * n_iter
+    assert m.value("hits_total", thread="0") == n_threads * n_iter / 2
+    assert m.value("obs_ms") == n_threads * n_iter
+
+
+def test_snapshot_dump_atomic_under_write_fault(tmp_path, monkeypatch):
+    """ENOSPC-style failure mid-dump: dump() returns False, never raises,
+    and the PREVIOUS snapshot file survives intact (tmp + os.replace)."""
+    m = MetricRegistry()
+    m.counter("a_total").inc(5)
+    path = str(tmp_path / "metrics.json")
+    assert m.dump(path) is True
+    first = json.load(open(path))
+
+    m.counter("a_total").inc(1)
+
+    def enospc(fd):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr("dorpatch_tpu.observe.metrics.os.fsync", enospc)
+    assert m.dump(path) is False  # degraded, not raised
+    monkeypatch.undo()
+    assert json.load(open(path)) == first  # prior snapshot intact
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+    assert m.dump(path) is True  # recovery on the next healthy dump
+    assert labeled_values(json.load(open(path)), "a_total", "") == {}
+    assert json.load(open(path))["metrics"]["a_total"]["series"][0]["value"] \
+        == 6
+
+
+def test_render_text_parse_exposition_round_trip():
+    m = MetricRegistry()
+    m.counter("req_total", help="requests").inc(3, status="ok")
+    m.counter("req_total").inc(status="o\"dd,\nlabel")
+    m.gauge("depth").set(2.5)
+    m.histogram("lat_ms", buckets=(1.0, 10.0)).observe(0.5)
+    text = m.render_text()
+    assert "# TYPE req_total counter" in text
+    parsed = parse_exposition(text)
+    assert parsed["req_total"][(("status", "ok"),)] == 3
+    assert parsed["req_total"][(("status", 'o"dd,\nlabel'),)] == 1
+    assert parsed["depth"][()] == 2.5
+    assert parsed["lat_ms_count"][()] == 1
+
+
+# ---------- trace-id propagation through failover ----------
+
+def test_trace_ids_survive_failover_redispatch(tmp_path):
+    """Chaos wedges replica 0 mid-batch: the re-dispatched requests'
+    trace ids must still reach a terminal record — the fleet report joins
+    serve.admit markers to downstream telemetry with ZERO orphans, and
+    the registry's books still reconcile exactly-once."""
+    rd = str(tmp_path / "serve")
+    svc = CertifiedInferenceService(
+        stub_apply, None, num_classes=N_CLASSES, img_size=IMG,
+        serve_cfg=ServeConfig(max_batch=2, bucket_sizes=(1, 2),
+                              deadline_ms=10000.0, max_queue_depth=64,
+                              replicas=2, max_restarts=2,
+                              restart_backoff_base=0.2,
+                              restart_backoff_cap=1.0,
+                              replica_stale_s=0.4, chaos="wedge_dispatch"),
+        defense_cfg=DefenseConfig(ratios=(0.1,), chunk_size=64),
+        result_dir=rd)
+    rng = np.random.default_rng(9)
+    images = rng.uniform(0.0, 1.0, (6, IMG, IMG, 3)).astype(np.float32)
+    tids = [f"trace{i:04d}" for i in range(len(images))]
+    results = [None] * len(images)
+    with svc:
+        def fire(i):
+            results[i] = svc.predict(images[i], deadline_ms=10000.0,
+                                     trace_id=tids[i])
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(len(images))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(isinstance(r, PredictResult) for r in results), \
+            [getattr(r, "status", r) for r in results]
+        assert int(svc.metrics.value("serve_failover_redispatched_total")) \
+            >= 1
+        # let the supervisor restart the wedged replica so stop() joins
+        # cleanly instead of waiting out the drain timeout
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            snap = {r["replica"]: r for r in svc.stats()["replicas"]}
+            if (snap.get(0, {}).get("state") == "healthy"
+                    and snap[0].get("generation", 0) >= 1):
+                break
+            time.sleep(0.2)
+
+    events = [json.loads(line) for line in open(f"{rd}/events.jsonl")]
+    opened = {e["trace"] for e in events
+              if e.get("name") == "serve.admit" and e.get("opens_trace")}
+    assert opened == set(tids)  # client-minted ids arrived verbatim
+    closed = set()
+    for e in events:
+        if e.get("opens_trace"):
+            continue
+        if isinstance(e.get("trace"), str):
+            closed.add(e["trace"])
+        if isinstance(e.get("traces"), list):
+            closed.update(t for t in e["traces"] if isinstance(t, str))
+    assert opened <= closed, sorted(opened - closed)
+
+    # the fleet summarizer reaches the same verdict from disk alone
+    fleet = report_mod.summarize_fleet_dirs([rd])
+    assert fleet["traces"]["orphans"] == []
+    assert fleet["requests"]["server_by_status"].get("ok") == len(images)

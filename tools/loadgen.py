@@ -25,10 +25,20 @@ Targets:
   (`python -m dorpatch_tpu.serve`); this process then never initializes an
   accelerator backend (pure sockets + the host-only percentile helper).
 
+Every ATTEMPT (each predict call, so an overloaded reject that gets
+retried counts once per try — exactly how the server counts it) lands in
+a client-side `observe.MetricRegistry` counter `loadgen_requests_total`.
+`--expect-metrics` then reconciles that counter against the server's
+`serve_requests_total` series — in-process by reading the service
+registry, over `--url` by scraping `GET /metrics` before and after the
+run and diffing — and exits non-zero on any per-status mismatch. With
+`--results-dir` the client registry is dumped to `metrics_client.json`
+there so `observe.report --fleet` can cross-check runs after the fact.
+
 Examples:
   python tools/loadgen.py --requests 16 --stub-victim --results-dir /tmp/s
   python tools/loadgen.py --requests 200 --mode open --rate 100 \
-      --url http://127.0.0.1:8700
+      --url http://127.0.0.1:8700 --expect-metrics
 """
 
 from __future__ import annotations
@@ -74,6 +84,33 @@ def _http_predict(url: str, image: np.ndarray, deadline_ms: float) -> dict:
         return {"status": "error", "reason": repr(e)}
 
 
+def _scrape_server_counts(url: str) -> dict:
+    """`serve_requests_total` by status from a live `GET /metrics`."""
+    import urllib.request
+
+    from dorpatch_tpu.observe import parse_exposition
+
+    with urllib.request.urlopen(url.rstrip("/") + "/metrics", timeout=30) as r:
+        parsed = parse_exposition(r.read().decode("utf-8"))
+    out: dict = {}
+    for key, value in (parsed.get("serve_requests_total") or {}).items():
+        for k, v in key:
+            if k == "status":
+                out[v] = out.get(v, 0.0) + value
+    return out
+
+
+def _reconcile(client_by_status: dict, server_by_status: dict) -> dict:
+    """Per-status exact cross-check: client attempts vs server answers."""
+    rows, ok = [], True
+    for s in sorted(set(client_by_status) | set(server_by_status)):
+        c = int(round(float(client_by_status.get(s, 0))))
+        v = int(round(float(server_by_status.get(s, 0))))
+        rows.append({"status": s, "client": c, "server": v, "ok": c == v})
+        ok = ok and c == v
+    return {"ok": ok, "by_status": rows}
+
+
 def _build_inprocess_service(args):
     """In-process target; imports jax lazily so --url runs stay host-only."""
     from dorpatch_tpu.config import DefenseConfig, ExperimentConfig, ServeConfig
@@ -106,12 +143,20 @@ def _build_inprocess_service(args):
         cfg, result_dir=args.results_dir or None)
 
 
-def run_load(send, images: np.ndarray, args) -> dict:
+def run_load(send, images: np.ndarray, args, metrics=None) -> dict:
     """Fire the workload; returns per-request (status, latency_s) tuples
-    aggregated into the report dict."""
+    aggregated into the report dict. When `metrics` (a client-side
+    MetricRegistry) is given, every attempt increments
+    `loadgen_requests_total{status=...}` — one inc per predict call, the
+    same granularity the server's `serve_requests_total` uses."""
     results = []
     retry = {"total": 0, "requests_retried": 0, "exhausted": 0}
     res_lock = threading.Lock()
+    m_attempts = (metrics.counter(
+        "loadgen_requests_total",
+        help="client-side attempts by terminal status (one per predict "
+             "call, retries counted individually)")
+        if metrics is not None else None)
     # closed loop only: an open-loop run MEASURES the overload response, so
     # retrying there would rewrite the arrival process it exists to impose
     retries = args.max_retries if args.mode == "closed" else 0
@@ -125,6 +170,8 @@ def run_load(send, images: np.ndarray, args) -> dict:
             resp = send(images[i % len(images)], args.deadline_ms)
             status = (resp.get("status", "error") if isinstance(resp, dict)
                       else resp.status)
+            if m_attempts is not None:
+                m_attempts.inc(status=str(status))
             if status != "overloaded" or attempt >= retries:
                 break
             attempt += 1
@@ -236,34 +283,67 @@ def main(argv=None) -> int:
                    help="keep the in-process service's telemetry here "
                         "(run.json + events.jsonl for the report CLI)")
     p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--expect-metrics", action="store_true",
+                   help="reconcile client-side attempt counts against the "
+                        "server's serve_requests_total series exactly; "
+                        "exit 1 on any per-status mismatch")
     p.add_argument("--out", default="", help="also write the JSON here")
     args = p.parse_args(argv)
 
+    from dorpatch_tpu.observe import MetricRegistry, labeled_values
+
     images = make_images(min(args.requests, 64), args.img_size, args.seed)
+    client_metrics = MetricRegistry()
+    server_counts = None
 
     if args.url:
+        server_before = (_scrape_server_counts(args.url)
+                         if args.expect_metrics else {})
         report = run_load(
-            lambda img, dl: _http_predict(args.url, img, dl), images, args)
+            lambda img, dl: _http_predict(args.url, img, dl), images, args,
+            metrics=client_metrics)
         report["target"] = args.url
+        if args.expect_metrics:
+            server_after = _scrape_server_counts(args.url)
+            server_counts = {
+                s: server_after.get(s, 0.0) - server_before.get(s, 0.0)
+                for s in set(server_after) | set(server_before)}
     else:
         service = _build_inprocess_service(args)
         with service:
             before = service.trace_counts()
             report = run_load(
                 lambda img, dl: service.predict(img, deadline_ms=dl).to_dict(),
-                images, args)
+                images, args, metrics=client_metrics)
             after = service.trace_counts()
             stats = service.stats()
+            if args.expect_metrics:
+                server_counts = labeled_values(
+                    service.metrics.snapshot(), "serve_requests_total",
+                    "status")
         report["target"] = "in-process"
         report["occupancy"] = stats["occupancy"]
         report["trace_counts"] = after
         report["zero_recompile"] = before == after
+
+    exit_code = 0
+    if args.expect_metrics:
+        client_counts = labeled_values(
+            client_metrics.snapshot(), "loadgen_requests_total", "status")
+        check = _reconcile(client_counts, server_counts or {})
+        report["metrics_check"] = check
+        if not check["ok"]:
+            exit_code = 1
+    if args.results_dir:
+        os.makedirs(args.results_dir, exist_ok=True)
+        client_metrics.dump(
+            os.path.join(args.results_dir, "metrics_client.json"))
     line = json.dumps(report)
     print(line)
     if args.out:
         with open(args.out, "w") as fh:
             fh.write(line + "\n")
-    return 0
+    return exit_code
 
 
 if __name__ == "__main__":

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Metrics-plane smoke: exact client/server reconciliation (CI gate,
+`run_tests.sh`).
+
+Three phases, one process, stub victim only:
+
+A. UNFAULTED — a 2-replica service answers a seeded closed-loop batch;
+   the client counts every predict attempt by terminal status into its
+   own registry. The service's `serve_requests_total` series must equal
+   the client counts BIT-FOR-BIT, and the Prometheus text exposition
+   (the `GET /metrics` body) must parse back to the same numbers.
+B. CHAOS — same shape but chaos wedges replica 0 mid-batch with requests
+   in flight. Failover re-dispatch must keep the books exact: every
+   request answered ok exactly once, counters still reconciling
+   bit-for-bit (nothing double-counted across the re-dispatch), and at
+   least one `serve_failover_redispatched_total` increment proving the
+   wedge landed.
+C. FLEET — `observe.report --fleet` over both run dirs must join client
+   and server snapshots, render the merged cross-process section, report
+   ZERO orphaned trace ids, and judge the fleet consistent.
+
+Prints ONE JSON line: {"metric": "metrics_smoke", "ok": true, ...};
+exits non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu.config import DefenseConfig, ServeConfig
+    from dorpatch_tpu.observe import (MetricRegistry, labeled_values,
+                                      parse_exposition)
+    from dorpatch_tpu.observe import report as report_mod
+    from dorpatch_tpu.serve.service import CertifiedInferenceService
+
+    num_classes, img = 5, 32
+
+    # fresh closure per service so jit trace caches never alias
+    def make_apply():
+        def apply_fn(params, x):
+            s = x.mean(axis=(1, 2, 3))
+            return jax.nn.one_hot((s * 7.0).astype(jnp.int32) % num_classes,
+                                  num_classes)
+        return apply_fn
+
+    defense_cfg = DefenseConfig(ratios=(0.1,), chunk_size=64)
+    rng = np.random.default_rng(7)
+    images = rng.uniform(0.0, 1.0, (12, img, img, 3)).astype(np.float32)
+
+    def drive(svc, client):
+        """Closed-loop pass; every attempt lands in the CLIENT registry
+        with the response's own terminal status — the numbers the server
+        series must match exactly."""
+        m = client.counter("loadgen_requests_total",
+                           help="client-side attempts by terminal status")
+        out = [None] * len(images)
+        nxt = {"i": 0}
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    i = nxt["i"]
+                    if i >= len(images):
+                        return
+                    nxt["i"] = i + 1
+                r = svc.predict(images[i], deadline_ms=15000.0)
+                m.inc(status=str(r.status))
+                out[i] = r
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
+    def counts_of(registry, name):
+        return {k: int(v) for k, v in labeled_values(
+            registry.snapshot(), name, "status").items()}
+
+    def exposition_counts(svc):
+        """serve_requests_total by status as a /metrics scraper sees it."""
+        parsed = parse_exposition(svc.metrics.render_text())
+        out = {}
+        for key, value in (parsed.get("serve_requests_total") or {}).items():
+            for k, v in key:
+                if k == "status":
+                    out[v] = out.get(v, 0) + int(value)
+        return out
+
+    failures = []
+    stats = {"metric": "metrics_smoke"}
+    dirs = {name: tempfile.mkdtemp(prefix=f"metrics-smoke-{name}-")
+            for name in ("plain", "chaos")}
+    try:
+        # ---- A: unfaulted 2-replica reconciliation ----
+        client = MetricRegistry()
+        svc = CertifiedInferenceService(
+            make_apply(), None, num_classes, img,
+            serve_cfg=ServeConfig(max_batch=4, bucket_sizes=(1, 2, 4),
+                                  deadline_ms=15000.0, replicas=2),
+            defense_cfg=defense_cfg, result_dir=dirs["plain"])
+        with svc:
+            got = drive(svc, client)
+            statuses = [getattr(r, "status", "?") for r in got]
+            server = counts_of(svc.metrics, "serve_requests_total")
+            scraped = exposition_counts(svc)
+        client_counts = counts_of(client, "loadgen_requests_total")
+        client.dump(os.path.join(dirs["plain"], "metrics_client.json"))
+        stats["plain"] = {"client": client_counts, "server": server}
+        if statuses != ["ok"] * len(images):
+            failures.append(f"unfaulted pass not all ok: {statuses}")
+        if client_counts != server:
+            failures.append(f"unfaulted counters diverge: client "
+                            f"{client_counts} vs server {server}")
+        if scraped != server:
+            failures.append(f"text exposition does not round-trip: "
+                            f"scraped {scraped} vs registry {server}")
+
+        # ---- B: wedged replica — exactly-once books across failover ----
+        client = MetricRegistry()
+        svc = CertifiedInferenceService(
+            make_apply(), None, num_classes, img,
+            serve_cfg=ServeConfig(max_batch=4, bucket_sizes=(1, 2, 4),
+                                  deadline_ms=15000.0, replicas=2,
+                                  max_restarts=2, restart_backoff_base=0.2,
+                                  restart_backoff_cap=1.0,
+                                  replica_stale_s=0.6,
+                                  chaos="wedge_dispatch"),
+            defense_cfg=defense_cfg, result_dir=dirs["chaos"])
+        with svc:
+            got = drive(svc, client)
+            statuses = [getattr(r, "status", "?") for r in got]
+            server = counts_of(svc.metrics, "serve_requests_total")
+            redispatched = int(svc.metrics.value(
+                "serve_failover_redispatched_total"))
+            completed = svc.stats()["completed"]
+            # let the supervisor finish quarantine+restart of the wedged
+            # replica so stop() does not wait out the drain timeout
+            deadline = time.time() + 90.0
+            while time.time() < deadline:
+                snap = {r["replica"]: r for r in svc.stats()["replicas"]}
+                if (snap.get(0, {}).get("state") == "healthy"
+                        and snap[0].get("generation", 0) >= 1):
+                    break
+                time.sleep(0.25)
+        client_counts = counts_of(client, "loadgen_requests_total")
+        client.dump(os.path.join(dirs["chaos"], "metrics_client.json"))
+        stats["chaos"] = {"client": client_counts, "server": server,
+                          "redispatched": redispatched,
+                          "completed": completed}
+        if statuses != ["ok"] * len(images):
+            failures.append(f"chaos pass lost/failed requests: {statuses}")
+        if client_counts != server:
+            failures.append(f"chaos counters diverge: client "
+                            f"{client_counts} vs server {server} — failover "
+                            f"double-counted or dropped a request")
+        if redispatched < 1:
+            failures.append("chaos never forced a failover re-dispatch — "
+                            "the wedge did not land mid-batch")
+        if completed != len(images):
+            failures.append(f"completed={completed} after {len(images)} "
+                            f"requests — double-answered or lost")
+
+        # ---- C: fleet join over both run dirs ----
+        fleet = report_mod.summarize_fleet_dirs(list(dirs.values()))
+        stats["fleet"] = {"orphans": fleet["traces"]["orphans"],
+                          "consistent": fleet["consistent"]}
+        if fleet["traces"]["orphans"]:
+            failures.append(f"fleet join left orphaned trace ids: "
+                            f"{fleet['traces']['orphans'][:4]}")
+        if not fleet["consistent"]:
+            failures.append(f"fleet cross-check inconsistent: "
+                            f"{fleet['checks']}")
+        rendered = report_mod.format_fleet_dirs(fleet)
+        if "-- cross-process --" not in rendered:
+            failures.append("fleet report does not render the merged "
+                            "cross-process section")
+        if "consistent: yes" not in rendered:
+            failures.append("fleet report does not judge the run "
+                            "consistent")
+    finally:
+        for d in dirs.values():
+            shutil.rmtree(d, ignore_errors=True)
+
+    stats["ok"] = not failures
+    stats["failures"] = failures
+    print(json.dumps(stats))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
