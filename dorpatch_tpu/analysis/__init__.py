@@ -1,6 +1,6 @@
 """Static analysis + program auditing + runtime sanitizers.
 
-Four wings, one invariant set:
+Five wings, one invariant set:
 
 - **AST** (`engine.py`, `rules_output.py`, `rules_jax.py`, `cli.py`):
   rules DP101-DP108 with stable IDs, `# noqa: DPxxx` suppressions, a
@@ -21,10 +21,18 @@ Four wings, one invariant set:
   regressions past tolerance, program-set and interface drift, and
   recompile-budget/bucket-ladder inconsistency. Catches what only a
   *cross-version* diff can show, without a bench.
-- **Runtime** (`sanitize.py`): the `--sanitize` pipeline flag — NaN
-  debugging, `jax.log_compiles` routed into observe events, and a
+- **Concurrency** (`concurrency.py`, `--concurrency`): rules
+  DP500-DP504 over the threaded packages (serve/farm/observe/recert,
+  backoff, chaos) — `# guarded-by:` lock-discipline violations, nested
+  lock-order (ABBA) cycles, blocking calls under a held lock, thread
+  lifecycle hygiene, and wall-clock liveness comparisons. Catches the
+  deadlock/race shapes that took PRs 11 and 16 to debug post-hoc.
+- **Runtime** (`sanitize.py`, `lockwatch.py`): the `--sanitize` pipeline
+  flag — NaN debugging, `jax.log_compiles` routed into observe events, a
   recompile-budget watchdog that fails the run when a jitted entry point
-  re-traces past its declared budget. Catches the remainder, live.
+  re-traces past its declared budget, and a lock sanitizer that records
+  real acquisition orders/held durations and fails on an inversion of
+  the static DP501 graph. Catches the remainder, live.
 
 The AST engine and rules are stdlib-only logic — ast + tokenize, no jax
 API calls — so linting never initializes (and on shared accelerators,

@@ -1,10 +1,15 @@
 """Analysis CLI: `python -m dorpatch_tpu.analysis [paths...]`.
 
-Four modes behind one exit contract (0 = clean, 1 = findings, 2 = usage
+Five modes behind one exit contract (0 = clean, 1 = findings, 2 = usage
 error; `run_tests.sh` gates on it):
 
-- **Lint** (default): the AST rules (DP101-DP108) over the package and
-  tools — pure ast/tokenize logic, never initializes a jax backend.
+- **Lint** (default): the AST rules (DP101-DP108 plus the concurrency
+  wing DP500-DP504) over the package and tools — pure ast/tokenize
+  logic, never initializes a jax backend.
+- **Concurrency** (`--concurrency`): ONLY the lock-discipline rules
+  (DP500-DP504) over the threaded packages — the same findings the
+  default lint gate folds in, isolated for CI labelling and focused
+  local runs.
 - **Trace** (`--trace`): the jaxpr-level auditor (DP200-DP206) over every
   registered production jit entry point, abstractly traced on CPU
   (`JAX_PLATFORMS=cpu`; zero device FLOPs). This mode imports jax and the
@@ -58,9 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m dorpatch_tpu.analysis",
         description="Static analysis for the dorpatch-tpu tree: AST rules "
-                    "DP101-DP108 (default), the jaxpr-level program "
-                    "auditor DP200-DP206 (--trace), and the program-"
-                    "baseline drift gate DP300-DP304 (--baseline); see "
+                    "DP101-DP108 + concurrency rules DP500-DP504 "
+                    "(default), the concurrency wing alone "
+                    "(--concurrency), the jaxpr-level program auditor "
+                    "DP200-DP206 (--trace), and the program-baseline "
+                    "drift gate DP300-DP304 (--baseline); see "
                     "--list-rules")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories to lint (default: "
@@ -74,6 +81,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--format", choices=("human", "json"), default="human",
                    help="finding output format: human `path:line:col:` "
                         "lines (default) or one JSON object per line")
+    p.add_argument("--concurrency", action="store_true",
+                   help="run only the lock-discipline rules (DP500-DP504) "
+                        "over the target paths — the concurrency gate "
+                        "(these rules also run in the default lint mode)")
     p.add_argument("--trace", action="store_true",
                    help="audit the registered jit entry points at the "
                         "jaxpr level (DP2xx) instead of linting source")
@@ -169,10 +180,12 @@ def _parse_select(raw: str, mode: str) -> Optional[List[str]]:
         return None
     select = [s.strip().upper() for s in raw.split(",") if s.strip()]
     from dorpatch_tpu.analysis.baseline import BASELINE_RULE_IDS
+    from dorpatch_tpu.analysis.concurrency import CONCURRENCY_RULE_IDS
     from dorpatch_tpu.analysis.program import TRACE_RULE_IDS
 
     wings = {
         "lint": {r.id for r in all_rules()} | {"DP000"},
+        "concurrency": set(CONCURRENCY_RULE_IDS) | {"DP000"},
         "trace": set(TRACE_RULE_IDS),
         "baseline": set(BASELINE_RULE_IDS),
     }
@@ -343,16 +356,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     # --baseline outranks --trace so `dorpatch-audit --baseline` (which
     # prepends --trace) reaches the baseline tier
     mode = ("baseline" if args.baseline
-            else "trace" if args.trace else "lint")
+            else "trace" if args.trace
+            else "concurrency" if args.concurrency else "lint")
     select = _parse_select(args.select, mode)
     if select == ["<usage-error>"]:
         return 2
     if args.diff and not args.fix:
         sys.stderr.write("--diff requires --fix\n")
         return 2
-    if args.fix and (args.trace or args.baseline):
-        sys.stderr.write("--fix and --trace/--baseline are separate modes; "
-                         "run them as two invocations\n")
+    if args.fix and (args.trace or args.baseline or args.concurrency):
+        sys.stderr.write("--fix and --trace/--baseline/--concurrency are "
+                         "separate modes; run them as two invocations\n")
+        return 2
+    if args.concurrency and (args.trace or args.baseline):
+        sys.stderr.write("--concurrency is a lint-side mode; run it "
+                         "separately from --trace/--baseline\n")
         return 2
     paths = args.paths or default_paths()
     if args.fix:
@@ -364,6 +382,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                              args.allow_remove)
     if args.trace:
         return _run_trace(select, args.entrypoints, args.format)
+    if args.concurrency and select is None:
+        from dorpatch_tpu.analysis.concurrency import CONCURRENCY_RULE_IDS
+        select = list(CONCURRENCY_RULE_IDS)
     try:
         findings = analyze_paths(paths, select=select)
     except (OSError, UnicodeDecodeError) as e:

@@ -237,7 +237,8 @@ def get_rule(rule_id: str) -> Rule:
 def _ensure_rules_loaded() -> None:
     # Rule modules self-register on import; importing here (not at module
     # top) keeps engine importable from the rule modules themselves.
-    from dorpatch_tpu.analysis import rules_jax, rules_output  # noqa: F401
+    from dorpatch_tpu.analysis import (concurrency, rules_jax,  # noqa: F401
+                                       rules_output)
 
 
 def analyze_source(source: str, path: str = "<string>",

@@ -20,6 +20,13 @@ flag (`ExperimentConfig.sanitize`):
   when the cache outgrows the budget — a shape-unstable call pattern
   (e.g. an unpadded dynamic batch) otherwise re-traces every step and
   turns a TPU run into a compile loop.
+- **Lock sanitizer** — the concurrency tier's runtime wing
+  (`lockwatch.py`): arms a process-wide `LockWatch` (seeded with the
+  static DP501 nested-`with` graph) so locks built through
+  `lockwatch.watched_lock` record their acquisition order and held
+  durations; an order inversion raises `LockOrderViolation`, a blown
+  hold budget raises `LockHoldBudgetExceeded` — same event-then-raise
+  contract as the recompile watchdog.
 
 Unlike the rest of the analysis package this module imports jax; only the
 runtime pipeline (and tests) load it.
@@ -38,6 +45,7 @@ from typing import Dict, Optional
 import jax
 
 from dorpatch_tpu import observe
+from dorpatch_tpu.analysis import lockwatch as _lockwatch
 from dorpatch_tpu.observe import events as _events
 
 
@@ -105,14 +113,30 @@ class Sanitizer:
     exit, so tests and nested runs never leak sanitizer state."""
 
     def __init__(self, debug_nans: bool = True, log_compiles: bool = True,
-                 recompile_budgets: bool = True):
+                 recompile_budgets: bool = True, lock_order: bool = True,
+                 lock_hold_budget_s: Optional[float] = None):
         self.debug_nans = debug_nans
         self.log_compiles = log_compiles
         self.recompile_budgets = recompile_budgets
+        self.lock_order = lock_order
         self.watchdog = RecompileWatchdog() if recompile_budgets else None
+        self.lock_watch = None
+        if lock_order or lock_hold_budget_s is not None:
+            # seed with the static DP501 graph so a runtime acquisition
+            # that inverts a source-committed order is caught on its very
+            # first execution; a broken static scan must not break arming
+            try:
+                from dorpatch_tpu.analysis.concurrency import \
+                    static_lock_graph
+                static = static_lock_graph()
+            except Exception:
+                static = None
+            self.lock_watch = _lockwatch.LockWatch(
+                hold_budget_s=lock_hold_budget_s, static_graph=static)
         self._handler: Optional[_CompileLogHandler] = None
         self._prev_flags: Dict[str, bool] = {}
         self._prev_guard = None
+        self._prev_watch = None
 
     def __enter__(self) -> "Sanitizer":
         if self.debug_nans:
@@ -127,13 +151,18 @@ class Sanitizer:
         if self.watchdog is not None:
             self._prev_guard = _events.recompile_guard()
             _events.set_recompile_guard(self.watchdog)
+        if self.lock_watch is not None:
+            self._prev_watch = _lockwatch.set_active_watch(self.lock_watch)
         observe.record_event(
             "sanitize.enabled", debug_nans=self.debug_nans,
             log_compiles=self.log_compiles,
-            recompile_budgets=self.recompile_budgets)
+            recompile_budgets=self.recompile_budgets,
+            lock_order=self.lock_watch is not None)
         return self
 
     def __exit__(self, *exc) -> None:
+        if self.lock_watch is not None:
+            _lockwatch.set_active_watch(self._prev_watch)
         if self.watchdog is not None:
             _events.set_recompile_guard(self._prev_guard)
         if self._handler is not None:

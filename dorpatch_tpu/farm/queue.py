@@ -46,6 +46,7 @@ import itertools
 import json
 import os
 import re
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -101,7 +102,11 @@ class JobQueue:
         # when it was first seen). Lease liveness must survive wall-clock
         # skew between hosts, so advancement of the writer's monotonic
         # `seq` — timed on the READER's clock — outranks the beat's `ts`.
-        self._hb_seq: Dict[str, Tuple[int, float]] = {}
+        # A queue instance may be shared across supervisor threads (the
+        # recert drainer polls while a reclaim sweep runs), so the cache
+        # read-check-update is atomic under `_lock`.
+        self._lock = threading.Lock()
+        self._hb_seq: Dict[str, Tuple[int, float]] = {}  # guarded-by: self._lock
 
     # ---------------- submit ----------------
 
@@ -258,17 +263,25 @@ class JobQueue:
             if beat is not None:
                 seq = beat.get("seq")
                 if isinstance(seq, int):
-                    prev = self._hb_seq.get(hb_path)
-                    if prev is not None and seq != prev[0]:
-                        # advancement since our last look: alive, full stop
-                        self._hb_seq[hb_path] = (seq, now)
-                        return True
-                    if prev is None:
-                        self._hb_seq[hb_path] = (seq, now)
-                    elif now - prev[1] > ttl:
-                        return False  # frozen a whole TTL on OUR clock: dead
-                return (now - float(beat["ts"])) <= ttl
-        return now <= float(lease.get("expires_ts", 0.0))
+                    # the read-check-update of the seq cache is atomic; the
+                    # heartbeat-file read above stays OUTSIDE the lock
+                    with self._lock:
+                        prev = self._hb_seq.get(hb_path)
+                        if prev is not None and seq != prev[0]:
+                            # advancement since our last look: alive
+                            self._hb_seq[hb_path] = (seq, now)
+                            return True
+                        if prev is None:
+                            self._hb_seq[hb_path] = (seq, now)
+                        # deliberate wall clock (injectable via `clock=`):
+                        # cross-process liveness cannot use a private
+                        # monotonic epoch, and the skew hazard is exactly
+                        # what the seq-preferred path above absorbs
+                        elif now - prev[1] > ttl:  # noqa: DP504 — injectable cross-process clock
+                            return False  # frozen a whole TTL: dead
+                # ts fallback (pre-seq beats): same deliberate wall clock
+                return (now - float(beat["ts"])) <= ttl  # noqa: DP504 — injectable cross-process clock
+        return now <= float(lease.get("expires_ts", 0.0))  # noqa: DP504 — injectable cross-process clock
 
     def _lease_record(self, job_id: str, worker_id: str, ttl: float,
                       heartbeat_path: str) -> Dict:

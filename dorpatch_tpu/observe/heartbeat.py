@@ -55,12 +55,12 @@ class Heartbeat:
         # throughput without waiting for the run to finish)
         self._get_extra = extra
         self._clock = clock
-        self._seq = 0
+        self._seq = 0  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._wedged = False
-        self._fh: Optional[IO[str]] = None
+        self._fh: Optional[IO[str]] = None  # guarded-by: self._lock
         if path:
             try:
                 os.makedirs(os.path.dirname(os.path.abspath(path)),
@@ -132,9 +132,12 @@ class Heartbeat:
             self._thread = None
         if self._fh is not None:
             if not self._wedged:
+                # outside the lock: beat() takes the same non-reentrant lock
                 self.beat(phase="exit")  # clean shutdown visible post-mortem
-            self._fh.close()
-            self._fh = None
+            with self._lock:
+                if self._fh is not None:
+                    self._fh.close()
+                    self._fh = None
 
     def __enter__(self):
         return self.start()

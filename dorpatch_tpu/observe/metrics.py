@@ -297,7 +297,7 @@ class MetricRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, _Metric] = {}
+        self._metrics: Dict[str, _Metric] = {}  # guarded-by: self._lock
 
     def _make(self, name: str, help: str, cls, **kw) -> _Metric:
         with self._lock:

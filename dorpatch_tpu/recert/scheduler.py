@@ -34,6 +34,7 @@ stack runs inside the farm workers.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -81,16 +82,24 @@ class RecertScheduler:
         # <recert_dir>/metrics.json at every completion so the fleet
         # report reads recert the same way it reads serve and farm dirs
         self.metrics = observe.MetricRegistry()
+        # one shared log handle, opened lazily on the first record: a fresh
+        # EventLog per record would restart its seq at 0 every time, and
+        # two threads recording at once (drain poller + canary gate) would
+        # interleave half-open handles on the same file
+        self._lock = threading.Lock()
+        self._elog = None  # guarded-by: self._lock
 
     def _record(self, name: str, **fields) -> None:
         """Append one event to the recert dir's own events.jsonl (the
         scheduler runs outside any job's event log, but its generation
         begin/complete records must land somewhere the fleet report can
         join on trace id)."""
-        log = observe.EventLog(
-            os.path.join(self.recert_dir, observe.events_filename(0)))
-        with log:
-            log.event(name, **fields)
+        with self._lock:
+            if self._elog is None:
+                self._elog = observe.EventLog(
+                    os.path.join(self.recert_dir,
+                                 observe.events_filename(0)))
+            self._elog.event(name, **fields)
 
     # ---------------- state ----------------
 
@@ -208,11 +217,15 @@ class RecertScheduler:
         """Poll until every job in the generation's farm is terminal.
         Quarantined/exhausted jobs count as terminal — a generation with
         holes completes (and reports them) instead of hanging."""
-        deadline = None if timeout is None else self._clock() + timeout
+        # the timeout is a local bound on OUR waiting, not a cross-process
+        # protocol — monotonic, so an NTP step mid-drain cannot expire it
+        # early or stretch it
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         while True:
             if self.drained(farm_dir):
                 return True
-            if deadline is not None and self._clock() >= deadline:
+            if deadline is not None and time.monotonic() >= deadline:
                 return False
             sleep(poll_interval)
 

@@ -61,7 +61,7 @@ class PendingRequest:
         # request — a second replica failure resolves it as an error
         self.redispatched = False
         self._claim_lock = threading.Lock()
-        self._claimed = False
+        self._claimed = False  # guarded-by: self._claim_lock
 
     def budget_s(self) -> float:
         return self.deadline - self.enqueued
@@ -101,12 +101,12 @@ class MicroBatcher:
                              f"{flush_fraction}")
         self.bucket_sizes = tuple(sorted(int(b) for b in bucket_sizes))
         self.max_batch = self.bucket_sizes[-1]
-        self.max_queue_depth = int(max_queue_depth)
+        self.max_queue_depth = int(max_queue_depth)  # guarded-by: self._cond
         self.flush_fraction = float(flush_fraction)
         self._clock = clock
         self._cond = threading.Condition()
-        self._pending = collections.deque()
-        self._closed = False
+        self._pending = collections.deque()  # guarded-by: self._cond
+        self._closed = False  # guarded-by: self._cond
 
     # ---------------- producer side ----------------
 
@@ -222,4 +222,9 @@ class MicroBatcher:
                         return []
                     self._cond.wait(give_up - now)
                 else:
-                    self._cond.wait()
+                    # deliberate untimed idle park: every producer path
+                    # (submit/requeue/close) notifies under this same
+                    # cond, and production workers always pass `timeout`
+                    # (the heartbeat tick) — only timeout-less callers
+                    # (tests, drains) can reach this branch
+                    self._cond.wait()  # noqa: DP502 — producers always notify

@@ -64,14 +64,35 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _stopping(self) -> bool:
+        """True during the service's stop() drain window: snapshot paths
+        race replica teardown there (the pool reference can go away mid-
+        handler), so observability endpoints answer a typed 503 instead of
+        a 500 — or a connection left hanging on a torn snapshot."""
+        stopping = getattr(self.service, "stopping", None)
+        return bool(stopping()) if callable(stopping) else False
+
+    @staticmethod
+    def _stopping_body() -> dict:
+        return {"status": "stopping",
+                "reason": "service is draining (stop() in progress)"}
+
     def do_GET(self) -> None:  # noqa: N802 — http.server contract
         if self.path == "/healthz":
             h = self.service.healthz()
             self._send_json(200 if h["status"] == "ok" else 503, h)
         elif self.path == "/stats":
-            self._send_json(200, self.service.stats())
+            if self._stopping():
+                self._send_json(503, self._stopping_body())
+            else:
+                self._send_json(200, self.service.stats())
         elif self.path == "/metrics":
-            self._send_text(200, self.service.metrics.render_text())
+            if self._stopping():
+                # typed refusal for the scrape too: Prometheus records the
+                # 503 as a failed scrape instead of a half-torn exposition
+                self._send_text(503, "# service stopping (drain window)\n")
+            else:
+                self._send_text(200, self.service.metrics.render_text())
         elif self.path == "/robustness":
             r = self.service.robustness()
             # canary-probe contract: 200 only on a clean verdict, 503 on

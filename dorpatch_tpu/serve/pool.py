@@ -75,22 +75,25 @@ class ReplicaHeartbeat(observe.Heartbeat):
 
 
 class Replica:
-    """One worker loop's exclusive state; all mutation happens under
-    `lock` or from the owning worker thread."""
+    """One worker loop's exclusive state. The lifecycle fields below carry
+    `# guarded-by: self.lock` contracts (enforced by DP500): every mutation
+    — worker batch bookkeeping AND the supervisor's state transitions —
+    holds `lock`, so a `/stats` snapshot mid-transition reads a consistent
+    (state, generation, restarts) triple instead of a torn one."""
 
     def __init__(self, slot: int, clean, defenses, heartbeat: ReplicaHeartbeat,
                  aot_stats: Optional[dict] = None):
         self.slot = int(slot)
-        self.generation = 0
-        self.state = "healthy"
-        self.restarts = 0
+        self.generation = 0  # guarded-by: self.lock
+        self.state = "healthy"  # guarded-by: self.lock
+        self.restarts = 0  # guarded-by: self.lock
         self.clean = clean
         self.defenses = defenses
         self.hb = heartbeat
         self.aot_stats = aot_stats
         self.thread: Optional[threading.Thread] = None
         self.lock = threading.Lock()
-        self.inflight: List[Any] = []
+        self.inflight: List[Any] = []  # guarded-by: self.lock
         self.fail_kind: Optional[str] = None
         self.fail_error: Optional[str] = None
         self.restart_at: Optional[float] = None
@@ -127,7 +130,7 @@ class ReplicaPool:
         self.batcher = service.batcher
         self._clock = service._clock
         self._chaos = chaos
-        self.replicas: List[Replica] = []
+        self.replicas: List[Replica] = []  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._stop_evt = threading.Event()
         self._supervisor: Optional[threading.Thread] = None
@@ -174,14 +177,18 @@ class ReplicaPool:
         r0 = Replica(0, self.svc._clean, self.svc.defenses,
                      ReplicaHeartbeat(self._hb_path(0), 0, self._clock),
                      aot_stats=self.svc._aot_stats)
-        self.replicas = [r0]
+        # build the full roster locally, publish once under the lock: the
+        # supervisor and /stats iterate a complete, never-mutated list
+        replicas = [r0]
         for slot in range(1, n):
             clean, defenses, aot_stats = self.svc._build_bank(slot)
-            self.replicas.append(
+            replicas.append(
                 Replica(slot, clean, defenses,
                         ReplicaHeartbeat(self._hb_path(slot), slot,
                                          self._clock),
                         aot_stats=aot_stats))
+        with self._lock:
+            self.replicas = replicas
         for r in self.replicas:
             self._launch(r)
             self._replica_event("start", r)
@@ -205,6 +212,12 @@ class ReplicaPool:
         """Stop supervising BEFORE the batcher closes: draining workers
         exit their loops naturally and must not be classified as failures."""
         self._stop_evt.set()
+
+    def stopping(self) -> bool:
+        """True from `begin_stop()` on — the drain window where the HTTP
+        frontend answers `/stats` and `/metrics` with a typed 503 instead
+        of racing a half-stopped service."""
+        return self._stop_evt.is_set()
 
     def join(self, timeout_s: float) -> bool:
         """Join the current-generation worker threads (abandoned wedged
@@ -325,7 +338,8 @@ class ReplicaPool:
                     elif (r.state == "quarantined"
                             and r.restart_at is not None
                             and now >= r.restart_at):
-                        r.state = "restarting"
+                        with r.lock:
+                            r.state = "restarting"
                         threading.Thread(
                             target=self._restart, args=(r,),
                             name=f"serve-restart-r{r.slot}",
@@ -347,12 +361,17 @@ class ReplicaPool:
     def _mark_sick(self, r: Replica, cause: str, now: float, **info) -> None:
         # the state transition and failover run to completion BEFORE any
         # telemetry: a throwing event sink must never strand a replica in
-        # "sick" (a state this method owns) or lose its in-flight requests
-        r.state = "sick"
+        # "sick" (a state this method owns) or lose its in-flight requests.
+        # Each transition holds r.lock (the DP500 contract on Replica
+        # state) in a short, non-nested scope — take_inflight() acquires
+        # the same non-reentrant lock, so it must never run inside one
+        with r.lock:
+            r.state = "sick"
         self._replica_event("sick", r)
         inflight = r.take_inflight()
         self._failover(inflight, now)
-        r.restarts += 1  # noqa: DP108 — control state, not a metric
+        with r.lock:
+            r.restarts += 1  # noqa: DP108 — control state, not a metric
         retire = r.restarts > int(getattr(self.cfg, "max_restarts", 0))
         delay = 0.0
         if not retire:
@@ -360,8 +379,9 @@ class ReplicaPool:
                 f"serve-r{r.slot}", r.restarts,
                 base=float(getattr(self.cfg, "restart_backoff_base", 0.5)),
                 cap=float(getattr(self.cfg, "restart_backoff_cap", 30.0)))
-            r.restart_at = now + delay
-            r.state = "quarantined"
+            with r.lock:
+                r.restart_at = now + delay
+                r.state = "quarantined"
         observe.record_event("serve.replica.sick", replica=r.slot,
                              generation=r.generation, cause=cause,
                              inflight=len(inflight), **info)
@@ -418,8 +438,9 @@ class ReplicaPool:
                 self._reject_all(requeue, "service stopping")
 
     def _retire(self, r: Replica) -> None:
-        r.state = "retired"
-        r.restart_at = None
+        with r.lock:
+            r.state = "retired"
+            r.restart_at = None
         healthy = max(self.healthy_count(), 0)
         total = len(self.replicas)
         retired = sum(1 for x in self.replicas if x.state == "retired")
@@ -447,7 +468,8 @@ class ReplicaPool:
                                  cause="restart_failed", error=repr(e),
                                  restarts=r.restarts)
             self._replica_event("quarantine", r)
-            r.restarts += 1  # noqa: DP108 — control state, not a metric
+            with r.lock:
+                r.restarts += 1  # noqa: DP108 — control state, not a metric
             if r.restarts > int(getattr(self.cfg, "max_restarts", 0)):
                 self._retire(r)
             else:
@@ -457,20 +479,26 @@ class ReplicaPool:
                                        0.5)),
                     cap=float(getattr(self.cfg, "restart_backoff_cap",
                                       30.0)))
-                r.restart_at = self._clock() + delay
-                r.state = "quarantined"
+                restart_at = self._clock() + delay
+                with r.lock:
+                    r.restart_at = restart_at
+                    r.state = "quarantined"
             return
-        r.generation += 1  # noqa: DP108 — control state, not a metric
-        r.clean, r.defenses = clean, defenses
-        r.aot_stats = aot_stats
-        r.hb = ReplicaHeartbeat(self._hb_path(r.slot), r.slot, self._clock)
-        r.fail_kind = r.fail_error = None
+        # the fresh heartbeat opens its JSONL file: build it BEFORE taking
+        # the lock so the hold stays a handful of pure assignments
+        hb = ReplicaHeartbeat(self._hb_path(r.slot), r.slot, self._clock)
+        with r.lock:
+            r.generation += 1  # noqa: DP108 — control state, not a metric
+            r.clean, r.defenses = clean, defenses
+            r.aot_stats = aot_stats
+            r.hb = hb
+            r.fail_kind = r.fail_error = None
+            r.state = "healthy"
         if r.slot == 0:
             # replica 0's bank IS the service's bank: trace_entrypoints,
             # trace_counts, and the defenses attribute must reflect the
             # programs that are actually serving
             self.svc._clean, self.svc.defenses = clean, defenses
-        r.state = "healthy"
         self._launch(r)
         self._replica_event("restart", r)
         observe.record_event(
@@ -497,14 +525,20 @@ class ReplicaPool:
 
             images = m.value("serve_replica_batch_images_total", replica=rl)
             slots = m.value("serve_replica_batch_slots_total", replica=rl)
+            # read the guarded lifecycle triple (and the hb reference)
+            # under the replica lock: a supervisor transition mid-snapshot
+            # must not produce a torn (state, generation, restarts) row
+            with r.lock:
+                state, generation = r.state, r.generation
+                restarts, hb = r.restarts, r.hb
             out.append({
                 "replica": r.slot,
-                "state": r.state,
-                "generation": r.generation,
-                "restarts": r.restarts,
+                "state": state,
+                "generation": generation,
+                "restarts": restarts,
                 "thread_alive": r.thread_alive(),
-                "last_phase": r.hb.last_phase,
-                "stale_s": round(r.hb.stale_s(now), 3),
+                "last_phase": hb.last_phase,
+                "stale_s": round(hb.stale_s(now), 3),
                 "batches": int(m.value("serve_replica_batches_total",
                                        replica=rl)),
                 "completed": int(m.value("serve_replica_completed_total",

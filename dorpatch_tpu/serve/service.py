@@ -387,6 +387,13 @@ class CertifiedInferenceService:
         deadlines still tolerate a slow compile straggler."""
         return max(2.0 * float(self.serve_cfg.deadline_ms) / 1e3, 5.0)
 
+    def stopping(self) -> bool:
+        """True inside stop()'s drain window (begin_stop() fired, pool not
+        yet released): the HTTP frontend answers /stats and /metrics with
+        a typed 503 for its duration instead of racing the teardown."""
+        pool = self._pool
+        return pool is not None and pool.stopping()
+
     def stop(self) -> None:
         if self._pool is None:
             return

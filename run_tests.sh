@@ -9,6 +9,13 @@ cd "$(dirname "$0")"
 # fails on any finding.
 python -m dorpatch_tpu.analysis dorpatch_tpu tools || exit $?
 echo "static analysis: OK"
+# Gate 1b: the concurrency tier (DP500-DP504) over the threaded packages —
+# guarded-by lock discipline, lock-order cycles, blocking calls under locks,
+# thread lifecycle, wall-clock liveness. Same stdlib-only engine; the
+# dedicated mode keeps the deadlock audit loud even when the default gate's
+# select set is narrowed.
+python -m dorpatch_tpu.analysis --concurrency dorpatch_tpu tools || exit $?
+echo "concurrency analysis (--concurrency): OK"
 # Gate 2: the jaxpr-level program auditor (DP200-DP206) — abstractly traces
 # every registered production jit entry point on CPU (attack block/sweep,
 # defense predict tables, train init/step/eval, model init, serve buckets,

@@ -1,0 +1,26 @@
+"""DP500 negatives: every mutation under the declared lock; reads and
+__init__ assignments are exempt."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: self._lock
+        self._count = 0  # guarded-by: self._lock
+        self._free = []  # no annotation: unguarded by contract
+
+    def add(self, item):
+        with self._lock:
+            self._items.append(item)
+            self._count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items), self._count
+
+    def peek_len(self):
+        return len(self._items)  # a read, not a mutation
+
+    def recycle(self, item):
+        self._free.append(item)  # unannotated attr: out of contract

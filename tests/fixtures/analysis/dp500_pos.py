@@ -1,0 +1,17 @@
+"""DP500 positives: guarded attributes mutated outside their lock."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: self._lock
+        self._count = 0  # guarded-by: self._lock
+
+    def add(self, item):
+        self._items.append(item)  # mutator call, lock not held
+        self._count += 1  # augmented assign, lock not held
+
+    def reset(self, other_lock):
+        with other_lock:
+            self._items.clear()  # the WRONG lock is held

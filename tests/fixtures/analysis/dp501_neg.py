@@ -1,0 +1,18 @@
+"""DP501 negative: both paths acquire in the same (canonical) order."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def forward(self):
+        with self._alock:
+            with self._block:
+                pass
+
+    def backward(self):
+        with self._alock:
+            with self._block:
+                pass

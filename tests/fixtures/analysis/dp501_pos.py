@@ -1,0 +1,18 @@
+"""DP501 positive: a planted ABBA lock-order cycle."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def forward(self):
+        with self._alock:
+            with self._block:
+                pass
+
+    def backward(self):
+        with self._block:
+            with self._alock:  # inverted: closes the cycle
+                pass

@@ -1,0 +1,27 @@
+"""DP502 negatives: timed waits under locks, blocking calls outside."""
+import os
+import queue
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._queue = queue.Queue()
+
+    def _run(self):
+        with self._lock:
+            item = self._queue.get(timeout=1.0)  # timed: bounded stall
+        time.sleep(0.1)  # outside the lock
+        return item
+
+    def park(self):
+        with self._cond:
+            self._cond.wait(0.5)  # timed wait: bounded stall
+
+    def render(self, parts, name):
+        with self._lock:
+            text = ", ".join(parts)  # str.join is pure
+            return os.path.join(text, name)  # os.path.join is pure

@@ -1,0 +1,23 @@
+"""DP502 positives: blocking calls inside `with <lock>` bodies."""
+import queue
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._queue = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            time.sleep(1.0)  # sleep under lock
+            item = self._queue.get()  # untimed queue get under lock
+            self._thread.join()  # thread join under lock
+            return item
+
+    def park(self):
+        with self._cond:
+            self._cond.wait()  # untimed Condition.wait

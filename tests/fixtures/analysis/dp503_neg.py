@@ -1,0 +1,24 @@
+"""DP503 negatives: daemon threads, joined threads, state-before-start."""
+import threading
+
+
+class Runner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "idle"  # guarded-by: self._lock
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()  # daemon: exempt; _state already assigned
+        self._sweeper = threading.Thread(target=self._run)
+        self._sweeper.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self._sweeper.join()  # lifecycle method joins the non-daemon
+
+
+def run_local():
+    t = threading.Thread(target=max)
+    t.start()
+    t.join()  # started and joined in the same function

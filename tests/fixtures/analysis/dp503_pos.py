@@ -1,0 +1,27 @@
+"""DP503 positives: unjoined non-daemon threads; a start() in __init__
+before guarded state is assigned."""
+import threading
+
+
+class Runner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=self._run)  # never joined
+        self._worker.start()  # starts before _state exists
+        self._state = "idle"  # guarded-by: self._lock
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        pass  # no join: process exit hangs on _worker
+
+
+def fire_and_forget():
+    threading.Thread(target=max).start()  # no reference left to join
+
+
+def run_local():
+    t = threading.Thread(target=max)
+    t.start()  # local thread started, never joined here
+    return t.name

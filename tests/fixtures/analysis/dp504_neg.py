@@ -1,0 +1,16 @@
+"""DP504 negatives: monotonic liveness; wall time without a liveness
+bound."""
+import time
+
+
+class Lease:
+    def __init__(self, ttl):
+        self.ttl = float(ttl)
+        self._last = time.monotonic()
+
+    def expired(self):
+        return time.monotonic() - self._last > self.ttl  # monotonic: fine
+
+    def wall_stamp_is_sane(self):
+        started = time.time()
+        return started > 0  # wall, but no liveness word in the compare

@@ -1,0 +1,16 @@
+"""DP504 positives: wall-clock values compared against liveness bounds."""
+import time
+
+
+class Lease:
+    def __init__(self, ttl, clock=time.time):
+        self.ttl = float(ttl)
+        self._clock = clock  # wall by default: tainted rebind
+        self._last = 0.0
+
+    def expired(self):
+        return self._clock() - self._last > self.ttl  # wall vs ttl
+
+    def before(self, deadline):
+        now = time.time()
+        return now < deadline  # tainted local vs deadline

@@ -361,8 +361,11 @@ def test_path_scoping_anchored_after_package_component():
 # ---------- engine surface ----------
 
 def test_rule_registry_stable_ids():
+    # the AST wing = the DP1xx rules plus the DP5xx concurrency tier (which
+    # rides the default lint gate; tests/test_concurrency.py owns its details)
+    from dorpatch_tpu.analysis.concurrency import CONCURRENCY_RULE_IDS
     rules = all_rules()
-    assert [r.id for r in rules] == list(RULE_IDS)
+    assert [r.id for r in rules] == list(RULE_IDS) + list(CONCURRENCY_RULE_IDS)
     assert all(r.description for r in rules)
     # fixable-offense listing contract: DP106 is the mechanical one
     assert [r.id for r in rules if r.fixable] == ["DP106"]
