@@ -7,7 +7,8 @@ hard way at runtime (PR 11's telemetry-call-strands-a-replica race, PR 16's
 wall-clock lease skew). This module is the "find the bug class before the
 chip does" philosophy applied to host concurrency: a stdlib-only,
 intraprocedural AST pass over the threaded packages (`serve/`, `farm/`,
-`observe/`, `recert/`, `backoff.py`, `chaos.py`), registered in the same
+`observe/`, `recert/`, `gateway/`, `backoff.py`, `chaos.py`), registered
+in the same
 engine as DP1xx so findings ride the standard `--select` / `# noqa: DP5xx`
 / exit-code machinery (and the default lint gate), plus a dedicated
 `--concurrency` CLI mode that runs only this wing.
@@ -76,7 +77,7 @@ CONCURRENCY_RULE_IDS = ("DP500", "DP501", "DP502", "DP503", "DP504")
 #: line-level `# noqa: DP5xx <reason>`.
 ALLOWLIST: Dict[str, Dict[str, str]] = {}
 
-_SCOPE_DIRS = ("serve", "farm", "observe", "recert")
+_SCOPE_DIRS = ("serve", "farm", "observe", "recert", "gateway")
 _SCOPE_FILES = ("backoff.py", "chaos.py")
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*self\.(\w+)")

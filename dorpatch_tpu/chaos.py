@@ -38,9 +38,28 @@ exception guard):
 - ``wedge_heartbeat``  — (shared name) the replica keeps serving but its
   heartbeat stops: staleness-based detection must fire even though the
   thread is alive.
+- ``kill_backend``     — SIGKILL the whole serve *process* mid-batch (the
+  fleet-gateway smoke's backend-loss story). Fires at a seeded batch
+  ordinal; before dying it invokes the bound ``metrics_flush`` callback so
+  the victim's committed counters reach metrics.json — the in-flight batch
+  is counted NOWHERE (the gateway retries it on a survivor), keeping the
+  fleet books exact. Always SIGKILLs regardless of ``crash_mode``: a raise
+  would be caught by the pool's failover and never leave the process.
 
 Serve faults always target replica 0 — the smoke's assertions need a known
 victim, and determinism beats configurability here.
+
+Gateway faults (wired by `gateway/` at its probe and deploy sites):
+
+- ``wedge_probe``     — the membership prober's next probes of a seeded
+  backend fail artificially (no socket touched), exercising the
+  consecutive-failure ejection path; the count is sized to cross the
+  ejection threshold, after which real probes resume and re-admission
+  hysteresis takes over.
+- ``poison_canary``   — the rolling deploy's robustness evaluation of the
+  canary is replaced ONCE with a failing DP400 verdict, proving the
+  automatic rollback + typed `gateway.rollback` event without needing a
+  genuinely-regressed model.
 
 Recert faults (wired by `recert/scheduler.py` at the cycle's one
 crash-interesting boundary, `on_recert` — called right after the
@@ -70,10 +89,12 @@ from typing import IO, Optional, Sequence
 
 FARM_FAULTS = ("crash_block", "ckpt_raise", "wedge_heartbeat",
                "enospc_events")
-SERVE_FAULTS = ("wedge_dispatch", "raise_in_worker", "wedge_heartbeat")
+SERVE_FAULTS = ("wedge_dispatch", "raise_in_worker", "wedge_heartbeat",
+                "kill_backend")
 RECERT_FAULTS = ("recert_kill_cycle", "recert_torn_state")
-FAULTS = (FARM_FAULTS + ("wedge_dispatch", "raise_in_worker")
-          + RECERT_FAULTS)
+GATEWAY_FAULTS = ("wedge_probe", "poison_canary")
+FAULTS = (FARM_FAULTS + ("wedge_dispatch", "raise_in_worker", "kill_backend")
+          + RECERT_FAULTS + GATEWAY_FAULTS)
 
 # The replica every serve fault is aimed at (see module docstring).
 SERVE_TARGET_REPLICA = 0
@@ -117,10 +138,20 @@ class Chaos:
         self.state_dir = os.path.abspath(state_dir)
         self.crash_mode = crash_mode
         self._block_counter = 0
+        self._serve_batch_counter = 0
+        self._probe_counter = {}
         self._heartbeat = None
+        self._metrics_flush = None
 
-    def bind(self, heartbeat=None) -> "Chaos":
-        self._heartbeat = heartbeat
+    def bind(self, heartbeat=None, metrics_flush=None) -> "Chaos":
+        """Wire process-level collaborators: `heartbeat` for the wedge
+        faults, `metrics_flush` (a zero-arg callable dumping the serve
+        metric registry to metrics.json) for `kill_backend`'s
+        flush-before-SIGKILL contract."""
+        if heartbeat is not None:
+            self._heartbeat = heartbeat
+        if metrics_flush is not None:
+            self._metrics_flush = metrics_flush
         return self
 
     # ---------------- fired-marker bookkeeping ----------------
@@ -238,6 +269,22 @@ class Chaos:
         warmup never routes through the worker loop)."""
         if replica_id != SERVE_TARGET_REPLICA:
             return
+        n = self._serve_batch_counter
+        self._serve_batch_counter += 1
+        if ("kill_backend" in self.faults
+                and n >= self.kill_backend_ordinal()
+                and self.fire_once("kill_backend")):
+            # Flush the committed counters first — this batch is still
+            # uncounted (the site runs before resolution), so the books on
+            # disk are exact and the gateway's retry keeps them exact.
+            if self._metrics_flush is not None:
+                try:
+                    self._metrics_flush()
+                except Exception:
+                    pass
+            # Always SIGKILL: crash_mode="raise" would be swallowed by the
+            # pool's failover and the process would keep serving.
+            os.kill(os.getpid(), signal.SIGKILL)
         if (heartbeat is not None and "wedge_heartbeat" in self.faults
                 and self.fire_once("wedge_heartbeat")):
             heartbeat.wedge()
@@ -250,6 +297,52 @@ class Chaos:
             # Freeze forever mid-batch: the daemon thread is abandoned and
             # the supervisor's staleness detection takes over.
             threading.Event().wait()
+
+    def kill_backend_ordinal(self) -> int:
+        """Which in-flight batch (counted per process) the SIGKILL targets
+        — never the first, so the backend provably answered something
+        before dying (the smoke asserts per-backend attribution)."""
+        return 1 + fault_seed(self.job_id, "kill_backend") % 2
+
+    # ---------------- gateway injection sites ----------------
+
+    def wedge_probe_failures(self) -> int:
+        """How many consecutive probes of the target backend fail
+        artificially — sized past any sane ejection threshold so the
+        wedge provably ejects, then real probes resume."""
+        return 4 + fault_seed(self.job_id, "wedge_probe") % 3
+
+    def on_gateway_probe(self, backend_index: int, backend_name: str) -> bool:
+        """Membership-prober site — called once per probe cycle per
+        backend BEFORE any socket is touched. Returns True when the probe
+        must be treated as failed. Targets backend index 0 (the smoke's
+        known victim, mirroring SERVE_TARGET_REPLICA); the first call
+        commits the fired-marker, then the next `wedge_probe_failures()`
+        probes of that backend fail."""
+        if "wedge_probe" not in self.faults or backend_index != 0:
+            return False
+        if self.fire_once("wedge_probe"):
+            self._probe_counter[backend_name] = self.wedge_probe_failures()
+        left = self._probe_counter.get(backend_name, 0)
+        if left <= 0:
+            return False
+        self._probe_counter[backend_name] = left - 1
+        return True
+
+    def poison_canary(self, verdict: dict) -> dict:
+        """Deploy-evaluation site — called by the rolling deploy with the
+        canary's real robustness verdict. Fires once, replacing it with a
+        failing DP400 (robustness regression) verdict; every later
+        evaluation passes the real verdict through."""
+        if ("poison_canary" in self.faults
+                and self.fire_once("poison_canary")):
+            return {"status": "failed",
+                    "findings_by_rule": {"DP400": [
+                        "chaos: injected robustness regression "
+                        "(certified-accuracy margin below baseline)"]},
+                    "worst_margin": -1.0,
+                    "poisoned": True}
+        return verdict
 
 
 class _CheckpointRaiseProxy:

@@ -9,8 +9,8 @@ from __future__ import annotations
 import argparse
 
 from dorpatch_tpu.config import (AotConfig, AttackConfig, DefenseConfig,
-                                 ExperimentConfig, FarmConfig, RecertConfig,
-                                 ServeConfig)
+                                 ExperimentConfig, FarmConfig, GatewayConfig,
+                                 RecertConfig, ServeConfig)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -221,6 +221,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "typed error unless the verdict exists and is ok, "
                         "so a fleet never serves silently-uncertified "
                         "(mirrors --aot strict)")
+    # fleet gateway (`python -m dorpatch_tpu.gateway` routes POST /predict
+    # across N serve processes; README "Fleet gateway")
+    p.add_argument("--gateway-backends", default="",
+                   help="comma-joined backend base URLs "
+                        "(http://host:port) the gateway fronts; each is a "
+                        "`python -m dorpatch_tpu.serve` process")
+    p.add_argument("--gateway-port", type=int, default=8800,
+                   help="gateway bind port (0 = ephemeral)")
+    p.add_argument("--gateway-probe-interval", type=float, default=1.0,
+                   help="per-backend health-probe cadence seconds "
+                        "(/healthz + /stats + /robustness, jittered)")
+    p.add_argument("--gateway-fail-threshold", type=int, default=3,
+                   help="consecutive probe failures before a backend is "
+                        "ejected from routing")
+    p.add_argument("--gateway-ok-threshold", type=int, default=2,
+                   help="consecutive probe successes before an ejected "
+                        "backend is re-admitted (flap hysteresis)")
+    p.add_argument("--gateway-inflight-cap", type=int, default=32,
+                   help="per-backend concurrent dispatches before the "
+                        "gateway answers typed Overloaded (503)")
+    p.add_argument("--gateway-canary-steps", default="0.1,0.5,1.0",
+                   help="rolling-deploy traffic fractions the canary group "
+                        "is stepped through (comma-joined floats)")
+    p.add_argument("--gateway-canary-hold", type=float, default=2.0,
+                   help="soak seconds per canary step before evaluating "
+                        "its robustness verdict")
     # farm (`python -m dorpatch_tpu.farm` shares these defaults; setting
     # them here persists them into the config record a spec's `base` carries)
     p.add_argument("--farm-lease-ttl", type=float, default=60.0,
@@ -242,7 +268,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "Farm faults: crash_block, ckpt_raise, "
                         "wedge_heartbeat, enospc_events. Serve faults "
                         "(python -m dorpatch_tpu.serve): wedge_dispatch, "
-                        "raise_in_worker, wedge_heartbeat")
+                        "raise_in_worker, wedge_heartbeat, kill_backend. "
+                        "Gateway faults (python -m dorpatch_tpu.gateway): "
+                        "wedge_probe, poison_canary")
     p.add_argument("--remat-policy", default="full",
                    choices=["full", "conv", "dots"],
                    help="what an active remat recomputes: full = the whole "
@@ -326,6 +354,17 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         recert=RecertConfig(dir=args.recert_dir,
                             baseline_file=args.recert_baseline,
                             require=args.require_recert),
+        gateway=GatewayConfig(
+            backends=tuple(b for b in args.gateway_backends.split(",") if b),
+            port=args.gateway_port,
+            probe_interval_s=args.gateway_probe_interval,
+            fail_threshold=args.gateway_fail_threshold,
+            ok_threshold=args.gateway_ok_threshold,
+            inflight_cap=args.gateway_inflight_cap,
+            canary_steps=tuple(float(s) for s in
+                               args.gateway_canary_steps.split(",") if s),
+            canary_hold_s=args.gateway_canary_hold,
+            chaos=args.chaos),
     )
 
 

@@ -12,7 +12,14 @@ from __future__ import annotations
 import dataclasses
 from typing import Tuple
 
-from dorpatch_tpu.masks import DEFAULT_RATIOS, NUM_MASKS_PER_AXIS
+# Single source of truth for the dropout/defense ratio schedule and the
+# R-covering axis count (`/root/reference/attack.py:83`,
+# `PatchCleanser.py:13`). These live HERE (not in masks.py, which
+# re-exports them) so that importing the config layer — and with it the
+# jax-free host-side processes, e.g. the fleet gateway — never drags in
+# jax: masks.py depends on config, never the other way around.
+DEFAULT_RATIOS: Tuple[float, ...] = (0.015, 0.03, 0.06, 0.12)
+NUM_MASKS_PER_AXIS: int = 6
 
 NUM_CLASSES = {"imagenet": 1000, "cifar10": 10, "cifar100": 100}
 
@@ -283,6 +290,60 @@ class RecertConfig:
     require: str = "off"            # off|warn|strict
 
 
+@dataclasses.dataclass(frozen=True)
+class GatewayConfig:
+    """Horizontal serve fleet front-end (`dorpatch_tpu/gateway/`): a
+    stdlib-only HTTP gateway routing `POST /predict` across N serve
+    *processes* (each a `python -m dorpatch_tpu.serve`).
+
+    Membership is probe-driven (`/healthz` + `/stats` + `/robustness` on a
+    jittered interval): `fail_threshold` CONSECUTIVE probe failures eject a
+    backend, `ok_threshold` consecutive successes re-admit it — the
+    hysteresis that keeps a flapping backend out. Routing is
+    power-of-two-choices over each backend's scraped occupancy/reject rate,
+    retrying connection-level failures on the next backend (never
+    re-dispatching a request the backend already answered). When every
+    routable backend is saturated the gateway answers a typed `Overloaded`
+    (503) instead of queueing."""
+
+    backends: Tuple[str, ...] = ()  # backend base URLs (http://host:port)
+    host: str = "127.0.0.1"
+    port: int = 8800                # gateway bind port (0 = ephemeral)
+    probe_interval_s: float = 1.0   # health-probe cadence per backend
+    probe_jitter: float = 0.2       # multiplicative interval jitter (anti
+                                    # thundering-herd across gateways)
+    probe_timeout_s: float = 5.0    # per-probe socket timeout
+    fail_threshold: int = 3         # consecutive probe failures -> ejected
+    ok_threshold: int = 2           # consecutive probe successes -> healthy
+                                    # (re-admission hysteresis)
+    check_robustness: bool = True   # poll GET /robustness: a failing
+                                    # verdict degrades (not ejects) the
+                                    # backend — routable only when no
+                                    # healthy backend remains
+    inflight_cap: int = 32          # per-backend concurrent dispatches the
+                                    # gateway allows before calling the
+                                    # fleet saturated
+    dispatch_retries: int = 1       # connection-failure retries, each on a
+                                    # backend the request has not touched
+    dispatch_timeout_s: float = 75.0  # per-dispatch socket timeout (never
+                                    # retried: the backend may still answer)
+    canary_steps: Tuple[float, ...] = (0.1, 0.5, 1.0)
+                                    # rolling-deploy traffic fractions the
+                                    # canary group is stepped through
+    canary_hold_s: float = 2.0      # soak time per step before evaluating
+                                    # the canary's robustness
+    autoscale_window_s: float = 30.0   # sliding window for the signal-only
+                                    # scale recommendations
+    autoscale_high_occupancy: float = 0.8  # scale-up above this mean occupancy
+    autoscale_low_occupancy: float = 0.2   # scale-down below (and no rejects)
+    autoscale_high_reject: float = 0.01    # scale-up above this reject rate
+    autoscale_cooldown_s: float = 60.0     # min gap between recommendations
+    chaos: str = ""                 # gateway-side fault injection (comma
+                                    # list of dorpatch_tpu.chaos
+                                    # GATEWAY_FAULTS: wedge_probe,
+                                    # poison_canary)
+
+
 def config_to_dict(cfg: "ExperimentConfig") -> dict:
     """JSON-safe nested dict of the full experiment config (reproducibility
     record written beside summary.json by the pipelines)."""
@@ -312,10 +373,11 @@ def config_from_dict(d: dict) -> "ExperimentConfig":
     farm = build(FarmConfig, d.pop("farm", {}))
     aot = build(AotConfig, d.pop("aot", {}))
     recert = build(RecertConfig, d.pop("recert", {}))
+    gateway = build(GatewayConfig, d.pop("gateway", {}))
     cfg = build(ExperimentConfig, d)
     return dataclasses.replace(cfg, attack=attack, defense=defense,
                                serve=serve, farm=farm, aot=aot,
-                               recert=recert)
+                               recert=recert, gateway=gateway)
 
 
 def resolved_data_source(cfg: "ExperimentConfig") -> str:
@@ -396,6 +458,7 @@ class ExperimentConfig:
     farm: FarmConfig = dataclasses.field(default_factory=FarmConfig)
     aot: AotConfig = dataclasses.field(default_factory=AotConfig)
     recert: RecertConfig = dataclasses.field(default_factory=RecertConfig)
+    gateway: GatewayConfig = dataclasses.field(default_factory=GatewayConfig)
 
     @property
     def num_classes(self) -> int:

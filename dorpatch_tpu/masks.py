@@ -25,11 +25,13 @@ import jax.numpy as jnp
 import numpy as np
 
 
-# Single source of truth for the dropout/defense ratio schedule and the
-# R-covering axis count (`/root/reference/attack.py:83`, `PatchCleanser.py:13`).
-# config.AttackConfig / config.DefenseConfig reference these.
-DEFAULT_RATIOS: Tuple[float, ...] = (0.015, 0.03, 0.06, 0.12)
-NUM_MASKS_PER_AXIS: int = 6
+# The ratio schedule and R-covering axis count live in config.py (the
+# jax-free layer) and are re-exported here for the geometry code and its
+# many historical importers — see the note beside their definition.
+from dorpatch_tpu.config import (  # noqa: F401  (re-export)
+    DEFAULT_RATIOS,
+    NUM_MASKS_PER_AXIS,
+)
 
 
 class MaskSpec(NamedTuple):

@@ -674,6 +674,16 @@ def summarize_fleet_dirs(dirs: List[str]) -> dict:
       snapshots (`metrics.json` from serve/farm/recert): per-status request
       counts must agree bit-for-bit, and the farm's outcome counters are
       folded in so a fleet that lost work cannot read as healthy.
+
+    When a GATEWAY snapshot is among the dirs (a `metrics.json` carrying
+    `gateway_requests_total`), the reconciliation becomes a three-way
+    chain instead of the flat client-vs-server check: client counts must
+    equal the gateway's per-status books (`kind: "client-gateway"`), and
+    the gateway's per-backend response counts must equal the sum of the
+    backends' own `serve_requests_total` books (`kind:
+    "gateway-backend"`) — gateway-local rejects (fleet `overloaded`)
+    live only in the first leg, and a SIGKILLed backend's unresolved
+    batch is counted NOWHERE, so both legs stay exact across failover.
     """
     events: List[dict] = []
     event_files = 0
@@ -725,14 +735,39 @@ def summarize_fleet_dirs(dirs: List[str]) -> dict:
     farm_outcomes = _sum_labeled(server_snaps, "farm_jobs_total", "outcome")
     recert_status = _sum_labeled(server_snaps, "recert_generations_total",
                                  "status")
+    gateway_status = _sum_labeled(server_snaps, "gateway_requests_total",
+                                  "status")
+    gateway_backend_status = _sum_labeled(
+        server_snaps, "gateway_backend_responses_total", "status")
+    gateway_by_backend = _sum_labeled(
+        server_snaps, "gateway_backend_responses_total", "backend")
+    rollbacks = _sum_total(server_snaps, "gateway_rollbacks_total")
+    autoscale = _sum_labeled(server_snaps, "gateway_autoscale_events_total",
+                             "direction")
 
     checks: List[dict] = []
-    if client_snaps:
+    if gateway_status:
+        # gateway in the fleet: reconcile the chain, one leg at a time
+        if client_snaps:
+            for status in sorted(set(gateway_status) | set(client_status)):
+                client_n = int(client_status.get(status, 0))
+                gw_n = int(gateway_status.get(status, 0))
+                checks.append({"kind": "client-gateway", "status": status,
+                               "client": client_n, "server": gw_n,
+                               "ok": client_n == gw_n})
+        for status in sorted(set(gateway_backend_status)
+                             | set(server_status)):
+            gw_n = int(gateway_backend_status.get(status, 0))
+            server_n = int(server_status.get(status, 0))
+            checks.append({"kind": "gateway-backend", "status": status,
+                           "client": gw_n, "server": server_n,
+                           "ok": gw_n == server_n})
+    elif client_snaps:
         for status in sorted(set(server_status) | set(client_status)):
             client_n = int(client_status.get(status, 0))
             server_n = int(server_status.get(status, 0))
-            checks.append({"status": status, "client": client_n,
-                           "server": server_n,
+            checks.append({"kind": "client-server", "status": status,
+                           "client": client_n, "server": server_n,
                            "ok": client_n == server_n})
     consistent = all(c["ok"] for c in checks) and not orphans
     return {
@@ -746,6 +781,11 @@ def summarize_fleet_dirs(dirs: List[str]) -> dict:
                    "opened_by_kind": _count_values(opened.values())},
         "requests": {"server_by_status": server_status,
                      "client_by_status": client_status},
+        "gateway": {"by_status": gateway_status,
+                    "backend_responses_by_status": gateway_backend_status,
+                    "by_backend": gateway_by_backend,
+                    "rollbacks": rollbacks,
+                    "autoscale_by_direction": autoscale},
         "farm_jobs_by_outcome": farm_outcomes,
         "recert_generations_by_status": recert_status,
         "checks": checks,
@@ -769,6 +809,18 @@ def _sum_labeled(snaps: List[dict], name: str, label: str) -> Dict[str, int]:
         for value, count in labeled_values(snap, name, label).items():
             out[value] = out.get(value, 0) + int(count)
     return dict(sorted(out.items()))
+
+
+def _sum_total(snaps: List[dict], name: str) -> int:
+    """Sum one counter's every series across snapshots (label-blind)."""
+    total = 0.0
+    for snap in snaps:
+        metric = (snap or {}).get("metrics", {}).get(name)
+        if not isinstance(metric, dict):
+            continue
+        for s in metric.get("series", ()):
+            total += float(s.get("value", 0.0))
+    return int(total)
 
 
 def _count_values(values) -> Dict[str, int]:
@@ -807,10 +859,24 @@ def format_fleet_dirs(s: dict) -> str:
     if rq["client_by_status"]:
         add("  client requests: " + ", ".join(
             f"{k}: {v}" for k, v in rq["client_by_status"].items()))
+    gw = s.get("gateway") or {}
+    if gw.get("by_status"):
+        add("  gateway requests: " + ", ".join(
+            f"{k}: {v}" for k, v in gw["by_status"].items()))
+    if gw.get("by_backend"):
+        add("  gateway responses by backend: " + ", ".join(
+            f"{k}: {v}" for k, v in gw["by_backend"].items()))
+    if gw.get("by_status") or gw.get("rollbacks"):
+        add(f"  gateway rollbacks: {gw.get('rollbacks', 0)}")
+    if gw.get("autoscale_by_direction"):
+        add("  gateway autoscale signals: " + ", ".join(
+            f"{k}: {v}" for k, v in gw["autoscale_by_direction"].items()))
     for c in s["checks"]:
         verdict = "ok" if c["ok"] else "MISMATCH"
-        add(f"  [{verdict:>8}] {c['status']}: client {c['client']} "
-            f"vs server {c['server']}")
+        kind = c.get("kind", "client-server")
+        left, right = (kind.split("-") + ["server"])[:2]
+        add(f"  [{verdict:>8}] {kind} {c['status']}: {left} {c['client']} "
+            f"vs {right} {c['server']}")
     if s["farm_jobs_by_outcome"]:
         add("  farm jobs: " + ", ".join(
             f"{k}: {v}" for k, v in s["farm_jobs_by_outcome"].items()))

@@ -79,8 +79,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 — http.server contract
         if self.path == "/healthz":
-            h = self.service.healthz()
-            self._send_json(200 if h["status"] == "ok" else 503, h)
+            if self._stopping():
+                # the drain window answers a typed 503 here too: a fleet
+                # gateway's health probe must see a clean "stopping" signal
+                # (and start draining the backend) instead of racing the
+                # pool teardown into a torn snapshot
+                self._send_json(503, self._stopping_body())
+            else:
+                h = self.service.healthz()
+                self._send_json(200 if h["status"] == "ok" else 503, h)
         elif self.path == "/stats":
             if self._stopping():
                 self._send_json(503, self._stopping_body())

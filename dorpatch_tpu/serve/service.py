@@ -373,6 +373,12 @@ class CertifiedInferenceService:
             chaos = Chaos(parse_faults(self.serve_cfg.chaos),
                           job_id="serve", state_dir=state_dir,
                           crash_mode="raise")
+            if self.result_dir:
+                # kill_backend's flush-before-SIGKILL contract: the fleet
+                # cross-check needs the victim's committed counters on disk
+                # even though stop() never runs
+                chaos.bind(metrics_flush=lambda: self.metrics.dump(
+                    os.path.join(self.result_dir, "metrics.json")))
         # the pool builds replicas 1..N-1 (fresh per-replica program banks,
         # AOT-booted and warmed through _build_bank), adopts replica 0's
         # bank from this service, launches every worker loop, and starts

@@ -178,3 +178,19 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   | grep -q '"ok": true' \
   || { echo "metrics smoke: client/server reconciliation violation"; exit 1; }
 echo "metrics smoke: OK"
+# Smoke: the horizontal serve fleet — two serve SUBPROCESSES strict-boot
+# from a shared AOT store behind the stdlib gateway; 24 closed-loop
+# requests route with answers bit-identical to direct service calls while
+# chaos SIGKILLs one backend mid-load (every request answered EXACTLY
+# once via connection-level retry, the corpse health-ejected); a canary
+# deploy from a second store version is poisoned with a DP400 robustness
+# verdict and must roll back automatically (typed gateway.rollback event
+# + restored stable weights); `observe.report --fleet` must reconcile the
+# client==gateway==sum-of-backends counter chain with ZERO orphaned trace
+# ids (tools/gateway_smoke.py exits non-zero and lists the violations
+# otherwise).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/gateway_smoke.py \
+  | grep -q '"ok": true' \
+  || { echo "gateway smoke: fleet routing/rollback violation"; exit 1; }
+echo "gateway smoke: OK"

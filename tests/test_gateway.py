@@ -1,0 +1,653 @@
+"""Fleet gateway: membership hysteresis, exactly-once routing, canary
+rollback, autoscale signals, and the HTTP surface.
+
+Everything here is jax-free and socket-local: backends are stub HTTP
+servers (`_StubServe`) whose behavior each test scripts — answer, hang
+up mid-request, fail health probes, or serve a planted DP400 robustness
+verdict. Membership is stepped deterministically via the registry's
+public `probe_cycle()` (no prober thread) wherever timing would
+otherwise matter."""
+
+import dataclasses
+import json
+import pathlib
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dorpatch_tpu.config import ExperimentConfig, GatewayConfig
+from dorpatch_tpu.gateway import (
+    Backend,
+    BackendRegistry,
+    Gateway,
+    GatewayFrontend,
+    RollingDeploy,
+    Router,
+)
+from dorpatch_tpu.gateway.membership import (
+    DEGRADED,
+    DRAINING,
+    EJECTED,
+    HEALTHY,
+    JOINING,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+OK_VERDICT = {"status": "ok", "generation": 1, "worst_margin": 0.2,
+              "findings_by_rule": {}, "cells": {}}
+DP400_VERDICT = {"status": "failed", "generation": 2, "worst_margin": -0.5,
+                 "findings_by_rule": {"DP400": ["planted regression"]},
+                 "cells": {}}
+
+
+def _cfg(**kw) -> GatewayConfig:
+    """Test-speed gateway knobs; override per test."""
+    # probe_interval_s is LONG: started gateways run exactly one probe
+    # sweep at boot and tests then drive membership deterministically
+    # (forced states or manual probe_cycle()), never racing the prober
+    base = dict(probe_interval_s=60.0, probe_timeout_s=2.0,
+                fail_threshold=3, ok_threshold=2, inflight_cap=4,
+                dispatch_retries=1, dispatch_timeout_s=5.0,
+                canary_steps=(1.0,), canary_hold_s=0.0,
+                autoscale_cooldown_s=1e9)
+    base.update(kw)
+    return GatewayConfig(**base)
+
+
+# ---------------------------------------------------------------- stubs
+
+
+class _StubServe:
+    """A scriptable stand-in for one `python -m dorpatch_tpu.serve`
+    process: /healthz /stats /robustness /predict with per-instance
+    mutable behavior and exact served counters."""
+
+    def __init__(self):
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    code = stub.healthz_code
+                    self._json(code, {"status": "ok" if code == 200
+                                      else "unhealthy"})
+                elif self.path == "/stats":
+                    self._json(200, dict(stub.stats))
+                elif self.path == "/robustness":
+                    code, verdict = stub.robustness
+                    self._json(code, verdict)
+                else:
+                    self._json(404, {"status": "error"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(n)
+                with stub.lock:
+                    stub.predicts += 1
+                    stub.trace_ids.append(
+                        self.headers.get("X-Trace-Id", ""))
+                if stub.predict_mode == "die":
+                    # hang up before any status line: the gateway sees a
+                    # connection-level failure (safe to re-dispatch)
+                    self.connection.close()
+                    return
+                if stub.predict_delay:
+                    time.sleep(stub.predict_delay)
+                with stub.lock:
+                    stub.answers += 1
+                self._json(200, {"status": "ok", "label": 1,
+                                 "certified": True})
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self.lock = threading.Lock()
+        self.predicts = 0     # requests that REACHED this backend
+        self.answers = 0      # requests this backend actually answered
+        self.trace_ids = []
+        self.healthz_code = 200
+        self.stats = {"occupancy": 0.1, "reject_rate": 0.0,
+                      "queue_depth": 0, "warm": True}
+        self.robustness = (200, OK_VERDICT)
+        self.predict_mode = "ok"
+        self.predict_delay = 0.0
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10.0)
+
+
+@pytest.fixture
+def stub():
+    s = _StubServe()
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def stub2():
+    s = _StubServe()
+    yield s
+    s.close()
+
+
+def _force_state(registry: BackendRegistry, name: str, state: str) -> None:
+    registry.set_state(name, state, reason="test")
+
+
+def _gateway(backends, tmp_path=None, **cfg_kw) -> Gateway:
+    cfg = _cfg(backends=tuple(b.url for b in backends), **cfg_kw)
+    return Gateway(cfg, result_dir=str(tmp_path) if tmp_path else "")
+
+
+# ------------------------------------------------- membership hysteresis
+
+
+def _scripted_registry(cfg, results):
+    """Registry over one fake backend whose probe results are scripted:
+    each probe_cycle consumes one (ok, stats, robust_ok, err) tuple."""
+    b = Backend("http://127.0.0.1:1")
+    transitions = []
+    reg = BackendRegistry(
+        [b], cfg,
+        on_transition=lambda *args: transitions.append(args))
+    script = iter(results)
+    reg._collect = lambda backend: next(script)
+    return b, reg, transitions
+
+
+OK = (True, {"occupancy": 0.5, "reject_rate": 0.0,
+             "queue_depth": 1, "warm": True}, True, "")
+FAIL = (False, None, True, "ConnectionRefusedError: x")
+OK_NOT_ROBUST = (True, None, False, "")
+
+
+def test_membership_joining_to_healthy_needs_ok_threshold():
+    b, reg, transitions = _scripted_registry(_cfg(ok_threshold=2), [OK, OK])
+    reg.probe_cycle()
+    assert b.snapshot()["state"] == JOINING  # one ok is not admission
+    reg.probe_cycle()
+    snap = b.snapshot()
+    assert snap["state"] == HEALTHY
+    assert snap["occupancy"] == 0.5 and snap["warm"]
+    assert (b.name, JOINING, HEALTHY, "probe_ok") in transitions
+
+
+def test_membership_ejects_after_consecutive_failures_only():
+    # fail/ok alternation never reaches fail_threshold=3 consecutively
+    b, reg, _ = _scripted_registry(
+        _cfg(fail_threshold=3, ok_threshold=2),
+        [FAIL, FAIL, OK, FAIL, FAIL, FAIL])
+    for _ in range(5):
+        reg.probe_cycle()
+    assert b.snapshot()["state"] == JOINING
+    reg.probe_cycle()  # third consecutive failure
+    assert b.snapshot()["state"] == EJECTED
+    assert b.snapshot()["last_error"].startswith("ConnectionRefusedError")
+
+
+def test_membership_readmission_hysteresis_defeats_flapping():
+    cfg = _cfg(fail_threshold=2, ok_threshold=2)
+    # ejected, then strict ok/fail flapping: never re-admits
+    b, reg, _ = _scripted_registry(
+        cfg, [FAIL, FAIL, OK, FAIL, OK, FAIL, OK, OK])
+    reg.probe_cycle()
+    reg.probe_cycle()
+    assert b.snapshot()["state"] == EJECTED
+    for expect in (JOINING, JOINING, JOINING, JOINING):
+        reg.probe_cycle()
+        state = b.snapshot()["state"]
+        assert state in (expect, EJECTED)  # flapping: joining<->ejected
+        assert state != HEALTHY
+    # two CONSECUTIVE oks finally re-admit
+    reg.probe_cycle()
+    reg.probe_cycle()
+    assert b.snapshot()["state"] == HEALTHY
+
+
+def test_membership_robustness_degrades_and_recovers():
+    b, reg, transitions = _scripted_registry(
+        _cfg(ok_threshold=1), [OK, OK_NOT_ROBUST, OK])
+    reg.probe_cycle()
+    assert b.snapshot()["state"] == HEALTHY
+    reg.probe_cycle()
+    assert b.snapshot()["state"] == DEGRADED
+    reg.probe_cycle()
+    assert b.snapshot()["state"] == HEALTHY
+    assert (b.name, HEALTHY, DEGRADED, "robustness") in transitions
+
+
+def test_membership_draining_is_never_left_automatically():
+    b, reg, _ = _scripted_registry(_cfg(ok_threshold=1), [OK, OK])
+    reg.probe_cycle()
+    reg.set_state(b.name, DRAINING, reason="test drain")
+    reg.probe_cycle()  # a good probe must NOT resurrect a draining backend
+    assert b.snapshot()["state"] == DRAINING
+    assert reg.routable() == []
+
+
+def test_live_probe_cycle_against_stub(stub):
+    """End-to-end probe over real sockets: /healthz + /stats + /robustness
+    feed state and load signals."""
+    cfg = _cfg(backends=(stub.url,), ok_threshold=1)
+    reg = BackendRegistry([Backend(stub.url)], cfg)
+    reg.probe_cycle()
+    snap = reg.backends()[0].snapshot()
+    assert snap["state"] == HEALTHY
+    assert snap["occupancy"] == 0.1 and snap["warm"]
+    stub.healthz_code = 503
+    for _ in range(cfg.fail_threshold):
+        reg.probe_cycle()
+    assert reg.backends()[0].snapshot()["state"] == EJECTED
+
+
+def test_wedge_probe_chaos_ejects_then_readmits(stub, tmp_path):
+    """Chaos ``wedge_probe`` forces the next N probes of backend index 0
+    to fail before any socket is touched: the healthy stub must be
+    ejected, and once the wedge exhausts, real probes must walk it back
+    through joining to healthy."""
+    gw = _gateway([stub], tmp_path=tmp_path, chaos="wedge_probe",
+                  ok_threshold=2)
+    chaos = gw.chaos
+    assert chaos is not None
+    wedged = chaos.wedge_probe_failures()
+    assert wedged >= gw.cfg.fail_threshold
+    for _ in range(gw.cfg.fail_threshold):
+        gw.registry.probe_cycle()
+    assert gw.registry.backends()[0].snapshot()["state"] == EJECTED
+    assert (tmp_path / "chaos_wedge_probe.fired").exists()
+    # drain the rest of the wedge, then recovery hysteresis kicks in
+    for _ in range(wedged - gw.cfg.fail_threshold):
+        gw.registry.probe_cycle()
+    states = []
+    for _ in range(gw.cfg.ok_threshold + 1):
+        gw.registry.probe_cycle()
+        states.append(gw.registry.backends()[0].snapshot()["state"])
+    assert JOINING in states and states[-1] == HEALTHY
+
+
+# ------------------------------------------------------- routing
+
+
+def test_route_retries_on_survivor_exactly_once(stub, stub2):
+    """A backend hanging up mid-request (SIGKILL shape) is retried on a
+    backend the request has NOT touched; the client sees exactly one
+    answer and the survivor serves it exactly once."""
+    stub.predict_mode = "die"
+    gw = _gateway([stub, stub2])
+    for s in (stub, stub2):
+        _force_state(gw.registry, f"127.0.0.1:{s.port}", HEALTHY)
+    # pin the dead backend first so the retry leg is deterministic
+    results = []
+    for _ in range(8):
+        r = gw.handle_predict(b"{}", trace_id="t-retry")
+        results.append(r)
+    assert all(r.payload["status"] == "ok" for r in results)
+    # every request answered exactly once, all by the survivor
+    assert stub.answers == 0
+    assert stub2.answers == 8
+    retried = [r for r in results if r.retries]
+    assert retried, "the dead backend was never even attempted"
+    for r in retried:
+        assert r.backend == f"127.0.0.1:{stub2.port}"
+        assert len(r.attempted) == 2 and len(set(r.attempted)) == 2
+    # books: one terminal status per request, retries counted separately
+    assert gw.metrics.value("gateway_requests_total", status="ok") == 8
+    assert gw.metrics.value("gateway_backend_responses_total",
+                            backend=f"127.0.0.1:{stub2.port}",
+                            status="ok") == 8
+    assert gw.metrics.value("gateway_retries_total") == len(retried)
+
+
+def test_route_all_ejected_is_typed_fleet_503(stub):
+    gw = _gateway([stub])
+    _force_state(gw.registry, f"127.0.0.1:{stub.port}", EJECTED)
+    r = gw.handle_predict(b"{}", trace_id="t-eject")
+    assert r.code == 503
+    assert r.payload["status"] == "overloaded"
+    assert r.payload["scope"] == "fleet"
+    assert r.payload["routable"] == 0 and r.payload["backends"] == 1
+    assert stub.predicts == 0  # nothing was dispatched anywhere
+    assert gw.metrics.value("gateway_requests_total",
+                            status="overloaded") == 1
+
+
+def test_route_inflight_cap_admission(stub):
+    gw = _gateway([stub], inflight_cap=1)
+    name = f"127.0.0.1:{stub.port}"
+    _force_state(gw.registry, name, HEALTHY)
+    b = gw.registry.get(name)
+    assert b.begin_dispatch(1)  # occupy the only slot
+    r = gw.handle_predict(b"{}", trace_id="t-cap")
+    assert r.code == 503 and r.payload["status"] == "overloaded"
+    b.end_dispatch()
+    r = gw.handle_predict(b"{}", trace_id="t-cap2")
+    assert r.payload["status"] == "ok"
+
+
+def test_route_timeout_is_never_retried(stub, stub2):
+    """A dispatch timeout must NOT re-dispatch (the backend may still
+    answer): typed deadline_exceeded, second backend untouched."""
+    stub.predict_delay = 1.0
+    gw = _gateway([stub], dispatch_timeout_s=0.2, dispatch_retries=3)
+    _force_state(gw.registry, f"127.0.0.1:{stub.port}", HEALTHY)
+    r = gw.handle_predict(b"{}", trace_id="t-slow")
+    assert r.code == 504
+    assert r.payload["status"] == "deadline_exceeded"
+    assert r.retries == 0 and len(r.attempted) == 1
+    assert stub2.predicts == 0
+
+
+def test_route_connection_failures_exhausted_is_internal_error():
+    cfg = _cfg(backends=("http://127.0.0.1:9",), dispatch_retries=2)
+    reg = BackendRegistry([Backend("http://127.0.0.1:9")], cfg)
+    _force_state(reg, "127.0.0.1:9", HEALTHY)
+    r = Router(reg, cfg).route(b"{}", "t-conn")
+    assert r.code == 500
+    assert r.payload["status"] == "internal_error"
+    assert "127.0.0.1:9" in r.payload["reason"]
+
+
+def test_router_prefers_healthy_over_degraded(stub, stub2):
+    gw = _gateway([stub, stub2])
+    _force_state(gw.registry, f"127.0.0.1:{stub.port}", DEGRADED)
+    _force_state(gw.registry, f"127.0.0.1:{stub2.port}", HEALTHY)
+    for i in range(6):
+        r = gw.handle_predict(b"{}", trace_id=f"t-{i}")
+        assert r.backend == f"127.0.0.1:{stub2.port}"
+    assert stub.predicts == 0
+    # the degraded backend is still a last resort
+    _force_state(gw.registry, f"127.0.0.1:{stub2.port}", EJECTED)
+    r = gw.handle_predict(b"{}", trace_id="t-last")
+    assert r.backend == f"127.0.0.1:{stub.port}"
+
+
+# ------------------------------------------------------- rolling deploy
+
+
+def test_deploy_promotes_clean_canary(stub, stub2, tmp_path):
+    gw = _gateway([stub], tmp_path=tmp_path, canary_steps=(0.5, 1.0))
+    stable = f"127.0.0.1:{stub.port}"
+    canary = f"127.0.0.1:{stub2.port}"
+    with gw:
+        _force_state(gw.registry, stable, HEALTHY)
+        gw.add_backend(stub2.url)  # weight 0: no traffic until the deploy
+        _force_state(gw.registry, canary, HEALTHY)
+        out = RollingDeploy(gw, [canary], hold_s=0.0).run(warm_timeout_s=5)
+    assert out["outcome"] == "promoted"
+    snaps = {s["name"]: s for s in
+             [b.snapshot() for b in gw.registry.backends()]}
+    assert snaps[canary]["weight"] == 1.0
+    assert snaps[stable]["state"] == DRAINING
+    assert snaps[stable]["weight"] == 0.0
+    events = [json.loads(line) for line in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    names = [e["name"] for e in events]
+    assert "gateway.deploy.begin" in names
+    assert "gateway.deploy.step" in names
+    assert "gateway.deploy.complete" in names
+    assert "gateway.rollback" not in names
+
+
+def test_deploy_rolls_back_on_planted_dp400(stub, stub2, tmp_path):
+    """A DP400 finding in the canary's robustness verdict rolls the fleet
+    back: canary drained, stable restored, typed event + counter."""
+    stub2.robustness = (503, DP400_VERDICT)
+    gw = _gateway([stub], tmp_path=tmp_path, canary_steps=(0.1, 1.0))
+    stable = f"127.0.0.1:{stub.port}"
+    canary = f"127.0.0.1:{stub2.port}"
+    with gw:
+        _force_state(gw.registry, stable, HEALTHY)
+        gw.add_backend(stub2.url)
+        _force_state(gw.registry, canary, HEALTHY)
+        out = RollingDeploy(gw, [canary], hold_s=0.0).run(warm_timeout_s=5)
+    assert out["outcome"] == "rolled_back"
+    assert "DP400" in out["reason"]
+    assert out["step"] == 0.1  # the FIRST step's gate caught it
+    assert any(f.startswith("DP400:") for f in out["findings"])
+    snaps = {s["name"]: s for s in
+             [b.snapshot() for b in gw.registry.backends()]}
+    assert snaps[canary]["state"] == DRAINING
+    assert snaps[canary]["weight"] == 0.0
+    assert snaps[stable]["weight"] == 1.0
+    assert gw.metrics.value("gateway_rollbacks_total") == 1
+    events = [json.loads(line) for line in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    rb = [e for e in events if e["name"] == "gateway.rollback"]
+    assert len(rb) == 1 and "DP400" in rb[0]["reason"]
+    # the dumped registry carries the rollback for the fleet report
+    metrics = json.loads((tmp_path / "metrics.json").read_text())
+    assert "gateway_rollbacks_total" in metrics["metrics"]
+
+
+def test_deploy_rolls_back_on_unreachable_canary(stub, tmp_path):
+    gw = _gateway([stub], tmp_path=tmp_path)
+    stable = f"127.0.0.1:{stub.port}"
+    with gw:
+        _force_state(gw.registry, stable, HEALTHY)
+        gw.add_backend("http://127.0.0.1:9")  # nothing listens there
+        out = RollingDeploy(gw, ["127.0.0.1:9"],
+                            hold_s=0.0).run(warm_timeout_s=0.3)
+    assert out["outcome"] == "rolled_back"
+    assert "never became healthy" in out["reason"]
+
+
+def test_poison_canary_chaos_forces_rollback(stub, stub2, tmp_path):
+    """chaos=poison_canary flips ONE healthy canary verdict to a failing
+    DP400 — the rollback machinery proves itself without a bad model."""
+    gw = _gateway([stub], tmp_path=tmp_path, chaos="poison_canary")
+    stable = f"127.0.0.1:{stub.port}"
+    canary = f"127.0.0.1:{stub2.port}"
+    with gw:
+        _force_state(gw.registry, stable, HEALTHY)
+        gw.add_backend(stub2.url)
+        _force_state(gw.registry, canary, HEALTHY)
+        out = RollingDeploy(gw, [canary], hold_s=0.0).run(warm_timeout_s=5)
+        assert out["outcome"] == "rolled_back"
+        assert "DP400" in out["reason"]
+        # the fault fires once: a second deploy of the same canary passes
+        _force_state(gw.registry, canary, HEALTHY)
+        out2 = RollingDeploy(gw, [canary], hold_s=0.0).run(warm_timeout_s=5)
+    assert out2["outcome"] == "promoted"
+    assert gw.metrics.value("gateway_rollbacks_total") == 1
+
+
+# ------------------------------------------------------- autoscale
+
+
+def test_autoscale_signals_and_cooldown(tmp_path):
+    from dorpatch_tpu import observe
+    from dorpatch_tpu.gateway.autoscale import Autoscaler
+
+    events = []
+    metrics = observe.MetricRegistry()
+    scaler = Autoscaler(
+        _cfg(autoscale_window_s=60.0, autoscale_high_occupancy=0.8,
+             autoscale_low_occupancy=0.2, autoscale_high_reject=0.01,
+             autoscale_cooldown_s=1e9),
+        metrics, lambda name, **a: events.append((name, a)))
+    assert scaler.observe(0.95, 0.0, routable=2) == "up"
+    assert scaler.observe(0.95, 0.0, routable=2) is None  # cooldown
+    assert metrics.value("gateway_autoscale_events_total",
+                         direction="up") == 1
+    assert metrics.value("gateway_autoscale_recommendation") == 1.0
+    assert events[0][0] == "gateway.autoscale"
+    assert events[0][1]["direction"] == "up"
+
+    # reject pressure alone also recommends up
+    m2 = observe.MetricRegistry()
+    s2 = Autoscaler(_cfg(autoscale_cooldown_s=0.0), m2,
+                    lambda name, **a: None)
+    assert s2.observe(0.5, 0.5, routable=2) == "up"
+
+    # idle fleet with zero rejects recommends down
+    m3 = observe.MetricRegistry()
+    s3 = Autoscaler(_cfg(autoscale_cooldown_s=0.0,
+                         autoscale_low_occupancy=0.2), m3,
+                    lambda name, **a: None)
+    assert s3.observe(0.05, 0.0, routable=3) == "down"
+    assert m3.value("gateway_autoscale_recommendation") == -1.0
+    # mid-band is steady: gauges update, no event
+    assert s3.observe(0.5, 0.0, routable=3) is None
+    assert m3.value("gateway_fleet_occupancy_mean") == pytest.approx(0.275)
+
+
+# ------------------------------------------------------- HTTP surface
+
+
+def test_gateway_http_surface_and_trace_forwarding(stub, tmp_path):
+    gw = _gateway([stub], tmp_path=tmp_path)
+    name = f"127.0.0.1:{stub.port}"
+    with gw, GatewayFrontend(gw, port=0) as fe:
+        base = f"http://127.0.0.1:{fe.port}"
+        # nothing routable yet: gateway healthz is 503 but answers
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz", timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "unhealthy"
+        _force_state(gw.registry, name, HEALTHY)
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["status"] == "ok" and h["routable"] == 1
+
+        req = urllib.request.Request(
+            base + "/predict", data=b'{"deadline_ms": 1000}',
+            headers={"Content-Type": "application/json",
+                     "X-Trace-Id": "trace-abc"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers["X-Trace-Id"] == "trace-abc"
+            body = json.loads(r.read())
+        assert body["status"] == "ok"
+        assert body["trace_id"] == "trace-abc"
+        assert body["gateway"]["backend"] == name
+        assert body["gateway"]["retries"] == 0
+        # the SAME id reached the backend: client->gateway->backend joins
+        assert stub.trace_ids == ["trace-abc"]
+
+        with urllib.request.urlopen(base + "/stats", timeout=10) as r:
+            stats = json.loads(r.read())
+        assert stats["role"] == "gateway"
+        assert stats["requests"] == {"ok": 1}
+        assert [b["name"] for b in stats["backends"]] == [name]
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert 'gateway_requests_total{status="ok"} 1' in text
+    # the admit/terminal pair landed in the gateway's OWN event log
+    events = [json.loads(line) for line in
+              (tmp_path / "events.jsonl").read_text().splitlines()]
+    admits = [e for e in events if e["name"] == "gateway.admit"]
+    terminals = [e for e in events if e["name"] == "gateway.request"]
+    assert len(admits) == len(terminals) == 1
+    assert admits[0]["trace"] == terminals[0]["trace"] == "trace-abc"
+    run = json.loads((tmp_path / "run.json").read_text())
+    assert run["kind"] == "gateway"
+
+
+def test_gateway_http_rejects_bad_bodies(stub):
+    gw = _gateway([stub])
+    with gw, GatewayFrontend(gw, port=0) as fe:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fe.port}/predict", data=b"[1, 2]",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+    assert stub.predicts == 0
+
+
+# ------------------------------------------------------- config / CLI
+
+
+def test_gateway_config_cli_roundtrip():
+    from dorpatch_tpu.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args([
+        "--gateway-backends", "http://a:1,http://b:2",
+        "--gateway-port", "9100",
+        "--gateway-probe-interval", "0.5",
+        "--gateway-fail-threshold", "5",
+        "--gateway-ok-threshold", "3",
+        "--gateway-inflight-cap", "8",
+        "--gateway-canary-steps", "0.25,1.0",
+        "--gateway-canary-hold", "0.5",
+    ])
+    cfg = config_from_args(args)
+    gw = cfg.gateway
+    assert gw.backends == ("http://a:1", "http://b:2")
+    assert gw.port == 9100
+    assert gw.probe_interval_s == 0.5
+    assert gw.fail_threshold == 5 and gw.ok_threshold == 3
+    assert gw.inflight_cap == 8
+    assert gw.canary_steps == (0.25, 1.0)
+    assert gw.canary_hold_s == 0.5
+
+
+def test_gateway_config_dict_roundtrip():
+    from dorpatch_tpu.config import config_from_dict, config_to_dict
+
+    cfg = ExperimentConfig(gateway=GatewayConfig(
+        backends=("http://x:1",), inflight_cap=7, canary_steps=(0.2, 1.0)))
+    wire = json.loads(json.dumps(config_to_dict(cfg)))
+    back = config_from_dict(wire)
+    assert back.gateway == cfg.gateway
+    with pytest.raises(ValueError):
+        wire["gateway"]["not_a_knob"] = 1
+        config_from_dict(wire)
+
+
+def test_gateway_package_is_jax_free():
+    """The gateway must boot without jax: routing needs sockets, not an
+    accelerator. Checked in a clean interpreter (this process already
+    imported jax via conftest)."""
+    import subprocess
+    import sys
+
+    code = ("import sys; import dorpatch_tpu.gateway; "
+            "sys.exit(1 if 'jax' in sys.modules else 0)")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=str(REPO),
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_gateway_package_passes_concurrency_lint():
+    """gateway/ is inside the DP5xx audit scope and must lint clean."""
+    from dorpatch_tpu.analysis import analyze_paths
+    from dorpatch_tpu.analysis.concurrency import (
+        CONCURRENCY_RULE_IDS,
+        in_concurrency_scope,
+    )
+    from dorpatch_tpu.analysis.engine import FileContext
+
+    src = REPO / "dorpatch_tpu" / "gateway" / "membership.py"
+    ctx = FileContext(path=str(src), source=src.read_text(encoding="utf-8"))
+    assert in_concurrency_scope(ctx)
+    findings = [f for f in analyze_paths([REPO / "dorpatch_tpu" / "gateway"])
+                if f.rule_id in CONCURRENCY_RULE_IDS]
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_gateway_config_frozen():
+    cfg = _cfg()
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        cfg.inflight_cap = 99

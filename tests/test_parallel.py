@@ -368,6 +368,15 @@ def test_two_process_multihost_feeding():
         assert f"proc {i}: OK" in out
 
 
+# Same known XLA limitation as test_two_process_multihost_feeding above:
+# the child processes run the CPU backend and the cross-process computation
+# dies with `XlaRuntimeError: INVALID_ARGUMENT: Multiprocess computations
+# aren't implemented on the CPU backend.` (see the supported-backends table
+# in https://jax.readthedocs.io/en/latest/multi_process.html). Kept for the
+# real multi-host TPU path, skipped on the CPU-only suite; drop the marker
+# when jaxlib ships CPU cross-process collectives.
+@pytest.mark.skip(
+    reason="multi-process computations unsupported on the XLA CPU backend")
 @pytest.mark.slow
 def test_two_process_experiment_driver(tmp_path):
     """Full `run_experiment` under jax.distributed (BASELINE config 5's

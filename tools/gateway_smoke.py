@@ -1,0 +1,434 @@
+#!/usr/bin/env python
+"""Fleet gateway smoke: exactly-once routing across real serve processes
+(CI gate, `run_tests.sh`).
+
+One parent process (this script) and real `serve` SUBPROCESSES — the
+cross-process shape the gateway exists for. The same file doubles as the
+backend launcher (`--serve-backend`): a stub-victim certified-inference
+service + HTTP front-end on an ephemeral port, announcing its bound port
+through a ready-file and draining cleanly on SIGTERM.
+
+Phases:
+
+A. FLEET BOOT — two AOT stores are populated in-parent, then two serve
+   backends STRICT-boot from store v1 (strict = provably warm: any miss
+   refuses boot instead of compiling) with an `ok` recert verdict behind
+   `GET /robustness`. A jax-free in-process gateway probes them healthy.
+B. PARITY + CHAOS KILL — 24 closed-loop requests ride POST /predict
+   through the gateway while chaos `kill_backend` SIGKILLs backend 2
+   mid-load (metrics flushed first — the flush-before-kill contract).
+   Every answer must match a direct parent-side service call bit-for-bit
+   (label + certified), every request is answered EXACTLY ONCE (the
+   router retries connection failures on the survivor, never an admitted
+   request), and the gateway ejects the corpse via health probes.
+C. CANARY ROLLBACK — a third backend strict-boots from store v2 and
+   rolls out via `RollingDeploy`; chaos `poison_canary` plants a DP400
+   finding in its robustness verdict, which must roll the fleet back
+   automatically (typed `gateway.rollback` event + counter, stable
+   weights restored) while the fleet keeps serving.
+D. FLEET REPORT — `observe.report --fleet` over client + gateway + all
+   three backend dirs must reconcile the three-way counter chain
+   (client == gateway == sum of backends, the killed backend's
+   unresolved batch counted NOWHERE) with ZERO orphaned trace ids, and
+   render the rollback trail.
+
+Prints ONE JSON line: {"metric": "gateway_smoke", "ok": true, ...};
+exits non-zero on any violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NUM_CLASSES, IMG = 5, 32
+OK_VERDICT = {"status": "ok", "generation": 1, "worst_margin": 0.25,
+              "findings_by_rule": {}, "cells": {}}
+
+
+def _make_apply():
+    """Deterministic weightless brightness classifier (imports jax —
+    backend/parity paths only; the gateway itself never does)."""
+    import jax
+    import jax.numpy as jnp
+
+    def apply_fn(params, x):
+        s = x.mean(axis=(1, 2, 3))
+        return jax.nn.one_hot((s * 7.0).astype(jnp.int32) % NUM_CLASSES,
+                              NUM_CLASSES)
+    return apply_fn
+
+
+def _build_service(result_dir: str, aot_store: str, aot_mode: str,
+                   recert_dir: str, chaos: str):
+    from dorpatch_tpu.config import (AotConfig, DefenseConfig, RecertConfig,
+                                     ServeConfig)
+    from dorpatch_tpu.serve.service import CertifiedInferenceService
+
+    # replicas=1 on purpose: with one worker loop the kill_backend flush
+    # can never race another replica's counter increments, so the victim's
+    # on-disk books are exactly its answered requests
+    serve_cfg = ServeConfig(max_batch=4, bucket_sizes=(1, 2, 4),
+                            deadline_ms=15000.0, replicas=1, chaos=chaos)
+    return CertifiedInferenceService(
+        _make_apply(), None, NUM_CLASSES, IMG,
+        serve_cfg=serve_cfg,
+        defense_cfg=DefenseConfig(ratios=(0.1,), chunk_size=64),
+        result_dir=result_dir or None,
+        aot_cfg=(AotConfig(cache_dir=aot_store, mode=aot_mode)
+                 if aot_store else None),
+        recert_cfg=(RecertConfig(dir=recert_dir, require="warn")
+                    if recert_dir else None))
+
+
+# ------------------------------------------------- backend launcher mode
+
+
+def serve_backend_main(args) -> int:
+    from dorpatch_tpu.serve.http import HttpFrontend
+
+    svc = _build_service(args.result_dir, args.aot_store, args.aot_mode,
+                         args.recert_dir, args.chaos)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    with svc, HttpFrontend(svc, "127.0.0.1", 0) as fe:
+        ready = {"ready": True, "port": fe.port, "pid": os.getpid(),
+                 "aot": (svc.stats().get("aot"))}
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(ready, fh)
+        os.replace(tmp, args.ready_file)  # atomic: parent never reads half
+        while not stop.is_set():
+            stop.wait(0.5)
+    return 0
+
+
+# ------------------------------------------------- parent-side helpers
+
+
+def _spawn_backend(result_dir: str, aot_store: str, recert_dir: str,
+                   chaos: str = ""):
+    """Launch one backend subprocess; returns (proc, ready_file, logpath)."""
+    os.makedirs(result_dir, exist_ok=True)
+    ready_file = os.path.join(result_dir, "ready.json")
+    logpath = os.path.join(result_dir, "backend.log")
+    cmd = [sys.executable, os.path.abspath(__file__), "--serve-backend",
+           "--result-dir", result_dir, "--ready-file", ready_file,
+           "--aot-store", aot_store, "--aot-mode", "strict",
+           "--recert-dir", recert_dir]
+    if chaos:
+        cmd += ["--chaos", chaos]
+    log = open(logpath, "w")
+    proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                            env=os.environ.copy())
+    return proc, ready_file, logpath
+
+
+def _await_ready(proc, ready_file: str, logpath: str,
+                 timeout_s: float = 600.0) -> dict:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if os.path.exists(ready_file):
+            with open(ready_file) as fh:
+                return json.load(fh)
+        if proc.poll() is not None:
+            break
+        time.sleep(0.2)
+    try:
+        with open(logpath) as fh:
+            tail = fh.read()[-2000:]
+    except OSError:
+        tail = "(no log)"
+    raise RuntimeError(
+        f"backend never became ready (exit={proc.poll()}): ...{tail}")
+
+
+def _get_json(url: str, timeout: float = 30.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post_predict(url: str, payload: dict, timeout: float = 120.0) -> dict:
+    body = json.dumps(payload).encode("utf-8")
+    req = urllib.request.Request(
+        url.rstrip("/") + "/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:  # typed rejects ride error codes
+        try:
+            return json.loads(e.read())
+        except ValueError:
+            return {"status": "error", "reason": f"http {e.code}"}
+
+
+def _stop_backend(proc, timeout_s: float = 120.0) -> int:
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+    return proc.returncode
+
+
+# ------------------------------------------------- the smoke
+
+
+def run_smoke() -> int:
+    import numpy as np
+
+    from dorpatch_tpu.config import GatewayConfig
+    from dorpatch_tpu.gateway import Gateway, GatewayFrontend, RollingDeploy
+    from dorpatch_tpu.gateway.membership import backend_name
+    from dorpatch_tpu.observe import MetricRegistry, labeled_values
+    from dorpatch_tpu.observe import report as report_mod
+
+    failures = []
+    stats = {"metric": "gateway_smoke"}
+    root = tempfile.mkdtemp(prefix="gateway-smoke-")
+    d = {name: os.path.join(root, name)
+         for name in ("backend1", "backend2", "canary", "gateway", "client",
+                      "store_v1", "store_v2", "recert")}
+    for path in d.values():
+        os.makedirs(path, exist_ok=True)
+    with open(os.path.join(d["recert"], "recert_verdict.json"), "w") as fh:
+        json.dump(OK_VERDICT, fh)
+
+    procs = []
+    try:
+        # ---- A: two AOT store versions, then a strict-booted fleet ----
+        for store in (d["store_v1"], d["store_v2"]):
+            svc = _build_service("", store, "auto", "", "")
+            with svc:
+                pass  # warm boot populates the store; nothing served
+        p1, rf1, lg1 = _spawn_backend(d["backend1"], d["store_v1"],
+                                      d["recert"])
+        p2, rf2, lg2 = _spawn_backend(d["backend2"], d["store_v1"],
+                                      d["recert"], chaos="kill_backend")
+        procs += [p1, p2]
+        r1 = _await_ready(p1, rf1, lg1)
+        r2 = _await_ready(p2, rf2, lg2)
+        urls = [f"http://127.0.0.1:{r['port']}" for r in (r1, r2)]
+        names = [backend_name(u) for u in urls]
+        stats["backends"] = {names[0]: {"aot": bool(r1.get("aot"))},
+                             names[1]: {"aot": bool(r2.get("aot")),
+                                        "chaos": "kill_backend"}}
+
+        cfg = GatewayConfig(
+            backends=tuple(urls), probe_interval_s=0.3, probe_jitter=0.1,
+            fail_threshold=2, ok_threshold=1, inflight_cap=32,
+            dispatch_retries=2, canary_steps=(0.5, 1.0), canary_hold_s=0.4,
+            chaos="poison_canary")
+        gateway = Gateway(cfg, result_dir=d["gateway"])
+        client = MetricRegistry()
+        m_attempts = client.counter(
+            "loadgen_requests_total",
+            help="client-side attempts by terminal status")
+        rng = np.random.default_rng(7)
+        images = rng.uniform(0.0, 1.0, (12, IMG, IMG, 3)).astype(np.float32)
+
+        with gateway, GatewayFrontend(gateway, port=0) as fe:
+            gw_url = f"http://127.0.0.1:{fe.port}"
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if gateway.healthz()["routable"] == 2:
+                    break
+                time.sleep(0.1)
+            else:
+                failures.append("fleet never probed healthy: "
+                                f"{gateway.healthz()}")
+
+            # ---- B: parity + chaos kill mid-load ----
+            # ground truth from a DIRECT service call (no gateway, no
+            # result_dir so its books stay out of the fleet join)
+            parity_svc = _build_service("", "", "off", "", "")
+            with parity_svc:
+                expected = [parity_svc.predict(img, deadline_ms=15000.0)
+                            .to_dict() for img in images]
+            # closed loop, concurrency 1: when the chaos kill fires,
+            # every previously-answered request has fully round-tripped,
+            # so the victim's flushed books are exactly its answers
+            by_backend, retried, parity_bad, statuses = {}, 0, 0, []
+            n_requests = 24
+            for i in range(n_requests):
+                want = expected[i % len(images)]
+                got = _post_predict(gw_url, {
+                    "image": images[i % len(images)].tolist(),
+                    "deadline_ms": 15000.0, "trace_id": f"gws-{i}"})
+                status = str(got.get("status", "error"))
+                m_attempts.inc(status=status)
+                statuses.append(status)
+                env = got.get("gateway") or {}
+                who = env.get("backend") or "(gateway)"
+                by_backend[who] = by_backend.get(who, 0) + 1
+                retried += 1 if env.get("retries") else 0
+                if status == "ok" and (
+                        got.get("label") != want.get("label")
+                        or got.get("certified") != want.get("certified")):
+                    parity_bad += 1
+            stats["load"] = {"by_backend": by_backend, "retried": retried,
+                             "statuses": sorted(set(statuses))}
+            if statuses != ["ok"] * n_requests:
+                failures.append(f"fleet load lost/failed requests: "
+                                f"{statuses}")
+            if parity_bad:
+                failures.append(f"{parity_bad}/{n_requests} gateway answers "
+                                "diverge from direct service calls")
+            marker = os.path.join(d["backend2"], "chaos_kill_backend.fired")
+            if not os.path.exists(marker):
+                failures.append("chaos kill_backend never fired — backend 2 "
+                                "survived the whole load")
+            if retried < 1:
+                failures.append("no request was ever re-dispatched — the "
+                                "kill did not land mid-load")
+            if by_backend.get(names[0], 0) < 1:
+                failures.append(f"survivor {names[0]} answered nothing: "
+                                f"{by_backend}")
+            try:
+                p2.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                failures.append("killed backend still running after load")
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                b2 = gateway.registry.get(names[1])
+                if b2 is not None and b2.snapshot()["state"] == "ejected":
+                    break
+                time.sleep(0.1)
+            else:
+                failures.append("gateway never ejected the killed backend")
+
+            # ---- C: canary deploy, poisoned verdict, auto-rollback ----
+            pc, rfc, lgc = _spawn_backend(d["canary"], d["store_v2"],
+                                          d["recert"])
+            procs.append(pc)
+            rc = _await_ready(pc, rfc, lgc)
+            canary_url = f"http://127.0.0.1:{rc['port']}"
+            canary = backend_name(canary_url)
+            gateway.add_backend(canary_url)  # weight 0 until the deploy
+            outcome = RollingDeploy(gateway, [canary]).run(warm_timeout_s=60)
+            stats["deploy"] = {"outcome": outcome["outcome"],
+                               "reason": outcome.get("reason", "")}
+            if outcome["outcome"] != "rolled_back":
+                failures.append(f"poisoned canary was not rolled back: "
+                                f"{outcome}")
+            elif "DP400" not in outcome["reason"]:
+                failures.append(f"rollback reason is not the planted DP400: "
+                                f"{outcome['reason']!r}")
+            if not os.path.exists(os.path.join(
+                    d["gateway"], "chaos_poison_canary.fired")):
+                failures.append("poison_canary fault never fired")
+            if int(gateway.metrics.value("gateway_rollbacks_total")) != 1:
+                failures.append("gateway_rollbacks_total != 1 after the "
+                                "rollback")
+            snaps = {s["name"]: s for s in
+                     (b.snapshot() for b in gateway.registry.backends())}
+            if snaps[canary]["state"] != "draining" \
+                    or snaps[canary]["weight"] != 0.0:
+                failures.append(f"canary not drained: {snaps[canary]}")
+            if snaps[names[0]]["weight"] != 1.0:
+                failures.append(f"stable weight not restored: "
+                                f"{snaps[names[0]]}")
+            # the fleet keeps serving after the rollback — on stable only
+            for i in range(4):
+                got = _post_predict(gw_url, {
+                    "image": images[i].tolist(), "deadline_ms": 15000.0,
+                    "trace_id": f"gws-post-{i}"})
+                status = str(got.get("status", "error"))
+                m_attempts.inc(status=status)
+                if status != "ok":
+                    failures.append(f"post-rollback request failed: {got}")
+                elif (got.get("gateway") or {}).get("backend") == canary:
+                    failures.append("post-rollback traffic reached the "
+                                    "drained canary")
+
+        # gateway stopped (books dumped); drain the live backends cleanly
+        for proc in (p1, pc):
+            code = _stop_backend(proc)
+            if code != 0:
+                failures.append(f"backend exited {code} on SIGTERM")
+        client.dump(os.path.join(d["client"], "metrics_client.json"))
+
+        # ---- D: the three-way fleet reconciliation ----
+        fleet_dirs = [d["client"], d["gateway"], d["backend1"],
+                      d["backend2"], d["canary"]]
+        fleet = report_mod.summarize_fleet_dirs(fleet_dirs)
+        stats["fleet"] = {
+            "orphans": fleet["traces"]["orphans"],
+            "consistent": fleet["consistent"],
+            "checks": fleet["checks"],
+            "gateway": fleet["gateway"]["by_status"],
+            "by_backend": fleet["gateway"]["by_backend"],
+            "rollbacks": fleet["gateway"]["rollbacks"],
+        }
+        client_counts = {k: int(v) for k, v in labeled_values(
+            client.snapshot(), "loadgen_requests_total", "status").items()}
+        if fleet["traces"]["orphans"]:
+            failures.append(f"fleet join left orphaned trace ids: "
+                            f"{fleet['traces']['orphans'][:4]}")
+        if not fleet["consistent"]:
+            failures.append(f"fleet cross-check inconsistent: "
+                            f"{fleet['checks']}")
+        if fleet["gateway"]["by_status"] != client_counts:
+            failures.append(
+                f"gateway books {fleet['gateway']['by_status']} != client "
+                f"books {client_counts}")
+        if fleet["gateway"]["rollbacks"] != 1:
+            failures.append("fleet report does not carry the rollback")
+        if len(fleet["gateway"]["by_backend"]) != 2:
+            failures.append(f"expected answers from exactly 2 backends: "
+                            f"{fleet['gateway']['by_backend']}")
+        rendered = report_mod.format_fleet_dirs(fleet)
+        for needle in ("-- cross-process --", "consistent: yes",
+                       "orphaned traces: 0", "gateway rollbacks: 1",
+                       "gateway responses by backend:"):
+            if needle not in rendered:
+                failures.append(f"fleet report missing {needle!r}")
+    except Exception as e:  # noqa: BLE001 — a smoke must report, not crash
+        failures.append(f"smoke crashed: {type(e).__name__}: {e}")
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+    stats["ok"] = not failures
+    stats["failures"] = failures
+    print(json.dumps(stats))
+    return 0 if not failures else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="fleet gateway smoke (parent) / backend launcher")
+    p.add_argument("--serve-backend", action="store_true",
+                   help="internal: run as one serve backend subprocess")
+    p.add_argument("--result-dir", default="")
+    p.add_argument("--ready-file", default="")
+    p.add_argument("--aot-store", default="")
+    p.add_argument("--aot-mode", default="off")
+    p.add_argument("--recert-dir", default="")
+    p.add_argument("--chaos", default="")
+    args = p.parse_args(argv)
+    if args.serve_backend:
+        return serve_backend_main(args)
+    return run_smoke()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

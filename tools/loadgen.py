@@ -24,6 +24,16 @@ Targets:
 - `--url http://host:port`: an already-running HTTP front-end
   (`python -m dorpatch_tpu.serve`); this process then never initializes an
   accelerator backend (pure sockets + the host-only percentile helper).
+- `--url ... --fleet`: the target is a **gateway**
+  (`python -m dorpatch_tpu.gateway`) fronting N serve processes. The JSON
+  line gains a `fleet` section with per-backend attribution (which backend
+  answered each request, read from the `gateway` envelope the gateway
+  stamps into every response), gateway-side connection retries, and
+  whether a rolling-deploy rollback happened during the run (gateway
+  `/stats` diff). `--expect-metrics` then reconciles against the
+  gateway's `gateway_requests_total` instead of `serve_requests_total` —
+  the gateway is the process that owes the client an exactly-once answer;
+  `observe.report --fleet` covers the gateway↔backend leg.
 
 Every ATTEMPT (each predict call, so an overloaded reject that gets
 retried counts once per try — exactly how the server counts it) lands in
@@ -84,8 +94,10 @@ def _http_predict(url: str, image: np.ndarray, deadline_ms: float) -> dict:
         return {"status": "error", "reason": repr(e)}
 
 
-def _scrape_server_counts(url: str) -> dict:
-    """`serve_requests_total` by status from a live `GET /metrics`."""
+def _scrape_server_counts(url: str,
+                          counter: str = "serve_requests_total") -> dict:
+    """`counter` by status from a live `GET /metrics` (a serve process's
+    `serve_requests_total`, or the gateway's `gateway_requests_total`)."""
     import urllib.request
 
     from dorpatch_tpu.observe import parse_exposition
@@ -93,11 +105,20 @@ def _scrape_server_counts(url: str) -> dict:
     with urllib.request.urlopen(url.rstrip("/") + "/metrics", timeout=30) as r:
         parsed = parse_exposition(r.read().decode("utf-8"))
     out: dict = {}
-    for key, value in (parsed.get("serve_requests_total") or {}).items():
+    for key, value in (parsed.get(counter) or {}).items():
         for k, v in key:
             if k == "status":
                 out[v] = out.get(v, 0.0) + value
     return out
+
+
+def _scrape_gateway_rollbacks(url: str) -> int:
+    """`rollbacks` counter from the gateway's `GET /stats`."""
+    import urllib.request
+
+    with urllib.request.urlopen(url.rstrip("/") + "/stats", timeout=30) as r:
+        stats = json.loads(r.read())
+    return int(stats.get("rollbacks", 0))
 
 
 def _reconcile(client_by_status: dict, server_by_status: dict) -> dict:
@@ -151,6 +172,10 @@ def run_load(send, images: np.ndarray, args, metrics=None) -> dict:
     same granularity the server's `serve_requests_total` uses."""
     results = []
     retry = {"total": 0, "requests_retried": 0, "exhausted": 0}
+    # --fleet: per-backend attribution from the `gateway` envelope the
+    # gateway stamps into every answer (terminal answers only — an
+    # overloaded reject retried in place re-attributes on the next try)
+    fleet = {"by_backend": {}, "gateway_retries": 0}
     res_lock = threading.Lock()
     m_attempts = (metrics.counter(
         "loadgen_requests_total",
@@ -178,6 +203,7 @@ def run_load(send, images: np.ndarray, args, metrics=None) -> dict:
             time.sleep(retry_delay(f"loadgen-{i}", attempt,
                                    base=args.retry_base, cap=args.retry_cap))
         dt = time.perf_counter() - t0
+        gw = (resp.get("gateway") if isinstance(resp, dict) else None) or {}
         with res_lock:
             results.append((status, dt))
             if attempt:
@@ -185,6 +211,11 @@ def run_load(send, images: np.ndarray, args, metrics=None) -> dict:
                 retry["requests_retried"] += 1
                 if status == "overloaded":
                     retry["exhausted"] += 1
+            if getattr(args, "fleet", False):
+                backend = gw.get("backend") or "(gateway)"
+                fleet["by_backend"][backend] = (
+                    fleet["by_backend"].get(backend, 0) + 1)
+                fleet["gateway_retries"] += int(gw.get("retries", 0))
 
     t_start = time.perf_counter()
     if args.mode == "closed":
@@ -234,7 +265,7 @@ def run_load(send, images: np.ndarray, args, metrics=None) -> dict:
         return None if v is None else round(v * 1e3, 3)
 
     total = len(results)
-    return {
+    report = {
         "metric": "serve_load",
         "mode": args.mode,
         "requests": total,
@@ -248,6 +279,12 @@ def run_load(send, images: np.ndarray, args, metrics=None) -> dict:
         if total else 0.0,
         "retries": dict(retry),
     }
+    if getattr(args, "fleet", False):
+        report["fleet"] = {
+            "by_backend": dict(sorted(fleet["by_backend"].items())),
+            "gateway_retries": fleet["gateway_retries"],
+        }
+    return report
 
 
 def main(argv=None) -> int:
@@ -270,6 +307,11 @@ def main(argv=None) -> int:
     p.add_argument("--url", default="",
                    help="target a running HTTP front-end instead of an "
                         "in-process service")
+    p.add_argument("--fleet", action="store_true",
+                   help="--url targets a gateway (python -m "
+                        "dorpatch_tpu.gateway): report per-backend "
+                        "attribution + rollbacks, reconcile "
+                        "--expect-metrics against gateway_requests_total")
     p.add_argument("--stub-victim", action="store_true",
                    help="serve a weightless brightness classifier (fast "
                         "CI smoke) instead of a real model")
@@ -292,19 +334,32 @@ def main(argv=None) -> int:
 
     from dorpatch_tpu.observe import MetricRegistry, labeled_values
 
+    if args.fleet and not args.url:
+        p.error("--fleet requires --url (a running gateway)")
+
     images = make_images(min(args.requests, 64), args.img_size, args.seed)
     client_metrics = MetricRegistry()
     server_counts = None
 
     if args.url:
-        server_before = (_scrape_server_counts(args.url)
+        # against a gateway the exactly-once contract the client can check
+        # is the gateway's own admission counter; the gateway↔backend leg
+        # belongs to `observe.report --fleet`
+        counter = ("gateway_requests_total" if args.fleet
+                   else "serve_requests_total")
+        server_before = (_scrape_server_counts(args.url, counter)
                          if args.expect_metrics else {})
+        rollbacks_before = (_scrape_gateway_rollbacks(args.url)
+                            if args.fleet else 0)
         report = run_load(
             lambda img, dl: _http_predict(args.url, img, dl), images, args,
             metrics=client_metrics)
         report["target"] = args.url
+        if args.fleet:
+            report["fleet"]["rollbacks_observed"] = (
+                _scrape_gateway_rollbacks(args.url) - rollbacks_before)
         if args.expect_metrics:
-            server_after = _scrape_server_counts(args.url)
+            server_after = _scrape_server_counts(args.url, counter)
             server_counts = {
                 s: server_after.get(s, 0.0) - server_before.get(s, 0.0)
                 for s in set(server_after) | set(server_before)}

@@ -146,8 +146,28 @@ def main(argv=None) -> int:
                                   chaos="wedge_dispatch"),
             defense_cfg=defense_cfg, result_dir=dirs["chaos"])
         with svc:
-            got = drive(svc, client)
-            statuses = [getattr(r, "status", "?") for r in got]
+            # The wedge can only land when replica 0 picks up a batch, and
+            # under single-core contention replica 1 can drain a whole pass
+            # alone — the PR 17 flake. Deterministic harness: re-drive the
+            # faulted leg until the O_EXCL fired-marker PROVES the fault
+            # landed (each pass counts into the same client registry, so
+            # the books stay exact), instead of hoping one pass wins the
+            # scheduling race. Once the marker exists the wedged batch's
+            # requests can only resolve through the supervisor's
+            # re-dispatch, so drive() returning implies redispatched >= 1.
+            marker = os.path.join(dirs["chaos"], "chaos_wedge_dispatch.fired")
+            statuses, rounds, max_rounds = [], 0, 20
+            while True:
+                got = drive(svc, client)
+                rounds += 1
+                statuses.extend(getattr(r, "status", "?") for r in got)
+                if os.path.exists(marker) or rounds >= max_rounds:
+                    break
+            if not os.path.exists(marker):
+                failures.append(
+                    f"chaos wedge_dispatch never fired in {rounds} passes "
+                    f"({rounds * len(images)} requests) — replica 0 never "
+                    f"picked up a batch")
             server = counts_of(svc.metrics, "serve_requests_total")
             redispatched = int(svc.metrics.value(
                 "serve_failover_redispatched_total"))
@@ -165,8 +185,9 @@ def main(argv=None) -> int:
         client.dump(os.path.join(dirs["chaos"], "metrics_client.json"))
         stats["chaos"] = {"client": client_counts, "server": server,
                           "redispatched": redispatched,
-                          "completed": completed}
-        if statuses != ["ok"] * len(images):
+                          "completed": completed, "rounds": rounds}
+        expected_n = rounds * len(images)
+        if statuses != ["ok"] * expected_n:
             failures.append(f"chaos pass lost/failed requests: {statuses}")
         if client_counts != server:
             failures.append(f"chaos counters diverge: client "
@@ -175,8 +196,8 @@ def main(argv=None) -> int:
         if redispatched < 1:
             failures.append("chaos never forced a failover re-dispatch — "
                             "the wedge did not land mid-batch")
-        if completed != len(images):
-            failures.append(f"completed={completed} after {len(images)} "
+        if completed != expected_n:
+            failures.append(f"completed={completed} after {expected_n} "
                             f"requests — double-answered or lost")
 
         # ---- C: fleet join over both run dirs ----
