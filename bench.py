@@ -342,15 +342,22 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
     kernels' exactness contracts (stem bit-exact at CPU f32, attention
     margin-contracted) — and prints `kernel_speedup` plus each side's
     static bytes-accessed and flops/byte (`kernel_roofline`, from the
-    baseline cost model over the phase-1 jaxpr; on CPU the gate resolves
-    off so the timed sides match and the row is a no-regression floor).
+    baseline cost model over the phase-1 jaxpr; on CPU without a mesh the
+    gate resolves off so the timed sides match and the row is a
+    no-regression floor — under BENCH_MESH on CPU the on-side runs
+    "interpret" instead, so the A/B exercises the real shard_map kernel
+    wrappers with the usual parity assertion).
 
     BENCH_MESH="DxM" (e.g. "4x2") runs the whole certify on a (data=D,
     mask=M) device mesh: the exhaustive sweep shards as before, the pruned
     path plans its phase-2 worklists shard-locally (defense._schedule_mesh)
     — so BENCH_PRUNE=ab on a mesh A/Bs sharded-pruned vs sharded-exhaustive
-    with the same parity contract, and the BENCH row carries the mesh
-    shape."""
+    with the same parity contract, and the BENCH row carries the mesh shape
+    plus the predicted DP600 comm vector (`comm_bytes`, explicit-collective
+    bytes of the phase-1 program) next to the measured wall-clock.
+    BENCH_KERNEL=ab composes with BENCH_MESH: both engine kernels run
+    through their shard_map wrappers and each roofline side stamps its
+    comm_bytes (zero IS the shard-local claim)."""
     import jax
     import jax.numpy as jnp
 
@@ -503,10 +510,18 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
                 "incremental engine — pick a ViT/ResMLP/conv family")
         raw_mode = {"token": "token", "mixer": "mixer"}.get(kind, "auto")
         base_prune = "exact" if prune == "off" else prune
+        on_gate = "auto"
+        if mesh is not None and jax.default_backend() == "cpu":
+            # on a CPU mesh "auto" resolves off and the A/B would be
+            # vacuous; "interpret" runs the real shard_map kernel wrappers
+            # (parity + recompile accounting on the exact meshed programs —
+            # the speedup column then measures schedule overhead, not
+            # kernel speed; on TPU "auto" stays the production gate)
+            on_gate = "interpret"
         d_off, x_final, dt_off, recs_off = time_mode(
             base_prune, x, incremental=raw_mode, use_pallas="off")
         d, _, dt, recs = time_mode(
-            base_prune, x, incremental=raw_mode, use_pallas="auto")
+            base_prune, x, incremental=raw_mode, use_pallas=on_gate)
         incr_mode = d.resolved_incremental(raw_mode)
         mism = [i for i, (a, b) in enumerate(zip(recs_off, recs))
                 if (a.prediction, a.certification) != (b.prediction,
@@ -548,14 +563,23 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
             kk = max(singles.shape[1], doubles.shape[1])
             rects = np.concatenate([masks_lib.pad_rects(singles, kk),
                                     masks_lib.pad_rects(doubles, kk)])
+            from dorpatch_tpu.analysis import comms as comms_lib
+
             ai = {}
             for gate, tag in (("interpret", "kernel"), ("off", "xla")):
                 fam = victim.incremental.build_family(
-                    rects, singles.shape[0], 128, 0.5, use_pallas=gate)
+                    rects, singles.shape[0], 128, 0.5, use_pallas=gate,
+                    mesh=mesh)
                 jaxpr = jax.make_jaxpr(fam.phase1)(victim.params, x)
                 cost = baseline_lib.estimate_cost(jaxpr)
                 ai[tag] = {"est_bytes": round(cost["est_bytes"], 1),
                            "flops_per_byte": round(cost["est_ai"], 3)}
+                if mesh is not None:
+                    # the DP600 comm vector of the meshed phase-1 program:
+                    # zero explicit-collective bytes IS the shard-local
+                    # wrapper claim, stamped next to the measured row
+                    ai[tag]["comm_bytes"] = round(
+                        comms_lib.comm_cost(jaxpr)["comm_bytes"], 1)
             prune_stats["kernel_roofline"] = ai
         except Exception as e:  # noqa: BLE001 - reporting axis only
             log(f"kernel roofline estimate unavailable ({e})")
@@ -632,6 +656,28 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
         "prune_rate": round(
             1.0 - sum(fwd) / (len(fwd) * d.num_forwards_exhaustive), 4),
     })
+
+    if mesh is not None:
+        # every meshed certify row stamps the predicted DP600 comm vector
+        # of its dominant program (the first-round phase-1 sweep) next to
+        # the measured wall-clock: explicit-collective bytes only, so the
+        # shard_map'd fills/kernels price exactly and a comm regression
+        # shows in the BENCH history like a flop regression. Estimate-only
+        # — failure just omits the numbers.
+        try:
+            from dorpatch_tpu.analysis import comms as comms_lib
+            from dorpatch_tpu.analysis.entrypoints import _unwrap
+
+            phase1 = getattr(d, "_phase1", None) or getattr(
+                d, "_predict", None)
+            jaxpr = jax.make_jaxpr(_unwrap(phase1))(victim.params, x_final)
+            cv = comms_lib.comm_cost(jaxpr)
+            prune_stats["comm_bytes"] = round(cv["comm_bytes"], 1)
+            if cv["by_collective"]:
+                prune_stats["comm_by_collective"] = {
+                    k: round(v, 1) for k, v in cv["by_collective"].items()}
+        except Exception as e:  # noqa: BLE001 - reporting axis only
+            log(f"comm vector estimate unavailable ({e})")
 
     # certify-mode MFU through the shared observe.StepTimer.summary formula:
     # forward-only FLOPs (XLA's own count at the chunked sweep's batch
@@ -1204,7 +1250,8 @@ def main() -> None:
               "parity_mismatches", "incr", "incr_speedup", "ips_pruned_only",
               "forward_equivalents_per_image",
               "forward_equivalents_total_per_image", "mesh",
-              "kernel", "kernel_speedup", "kernel_roofline"):
+              "kernel", "kernel_speedup", "kernel_roofline",
+              "comm_bytes", "comm_by_collective"):
         if res.get(k) is not None:
             out[k] = res[k]
     if fallback is not None:

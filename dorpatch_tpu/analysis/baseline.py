@@ -63,6 +63,7 @@ from typing import (Any, Dict, Iterable, List, Mapping, Optional, Sequence,
 
 from dorpatch_tpu.analysis.engine import Finding
 from dorpatch_tpu.analysis.entrypoints import EntryPoint
+from dorpatch_tpu.analysis import comms as comms_mod
 from dorpatch_tpu.analysis import program as program_mod
 
 #: The checked-in baseline, shipped inside the package so the gate and the
@@ -469,6 +470,15 @@ def snapshot_entrypoint(ep: EntryPoint, compiled: bool = True
     # and a ratio of gated metrics would double-report every regression
     entry["cost"]["est_ai"] = est["est_ai"]
     entry["primitives"] = est["primitives"]
+    # the comms tier's statically priced collective inventory: total bytes
+    # as a gated DP301 metric, the per-collective breakdown next to the
+    # flop `primitives` so a comm regression names its dominant collective.
+    # Meshed-jit programs with only GSPMD-inserted collectives correctly
+    # price to zero — the vector covers EXPLICIT collectives (shard_map /
+    # pmap bodies), where every hand-written comm pattern lives.
+    comm = comms_mod.comm_cost(ctx.jaxpr)
+    entry["cost"]["comm_bytes"] = comm["comm_bytes"]
+    entry["comm"] = comm["by_collective"]
     if compiled and getattr(ctx, "traced", None) is not None:
         cc = compiled_cost(ctx.traced)
         if cc is not None:
@@ -584,7 +594,7 @@ def _fmt_count(x: float) -> str:
 #: buffer assignment and jitters; flops/bytes are step functions).
 _COST_METRICS: Tuple[Tuple[str, float], ...] = (
     ("flops", 1.0), ("bytes", 1.0), ("temp_bytes", TEMP_TOLERANCE_FACTOR),
-    ("est_flops", 1.0), ("est_bytes", 1.0),
+    ("est_flops", 1.0), ("est_bytes", 1.0), ("comm_bytes", 1.0),
 )
 
 
@@ -606,16 +616,26 @@ def _cost_findings(name: str, live: Mapping[str, Any],
     if worst is None:
         return []
     metric, rel, bv, lv, eff_tol = worst
-    lprims = live.get("primitives", {}) or {}
-    bprims = base.get("primitives", {}) or {}
+    if metric == "comm_bytes":
+        # a comm regression names its dominant collective, from the comms
+        # tier's per-collective breakdown, not the flop table
+        lprims = live.get("comm", {}) or {}
+        bprims = base.get("comm", {}) or {}
+        unit = "comm bytes"
+        kind = "collective"
+    else:
+        lprims = live.get("primitives", {}) or {}
+        bprims = base.get("primitives", {}) or {}
+        unit = "est flops"
+        kind = "primitive"
     deltas = sorted(
         ((p, float(lprims.get(p, 0.0)) - float(bprims.get(p, 0.0)))
          for p in set(lprims) | set(bprims)),
         key=lambda kv: (-kv[1], kv[0]))
     dom = ""
     if deltas and deltas[0][1] > 0:
-        dom = (f"; dominant primitive increase: {deltas[0][0]} "
-               f"(+{_fmt_count(deltas[0][1])} est flops)")
+        dom = (f"; dominant {kind} increase: {deltas[0][0]} "
+               f"(+{_fmt_count(deltas[0][1])} {unit})")
     return [Finding(
         path=path, line=line, col=1, rule_id="DP301",
         message=f"[{name}] {metric} regressed {100.0 * rel:.1f}% over the "

@@ -1,6 +1,6 @@
 """Analysis CLI: `python -m dorpatch_tpu.analysis [paths...]`.
 
-Five modes behind one exit contract (0 = clean, 1 = findings, 2 = usage
+Six modes behind one exit contract (0 = clean, 1 = findings, 2 = usage
 error; `run_tests.sh` gates on it):
 
 - **Lint** (default): the AST rules (DP101-DP108 plus the concurrency
@@ -15,6 +15,12 @@ error; `run_tests.sh` gates on it):
   (`JAX_PLATFORMS=cpu`; zero device FLOPs). This mode imports jax and the
   production modules — it is the one analysis mode that is not
   backend-neutral to *import*, which is why it is opt-in.
+- **Comms** (`--comms`): the sharding & collectives auditor (DP600-DP603)
+  over the same entry points `--trace` audits — statically priced
+  collective inventories, accidental replication, boundary reshards, and
+  the shard-local kernel proof. Imports jax like `--trace`; run it under
+  `XLA_FLAGS=--xla_force_host_platform_device_count=8` so the `.mesh`
+  program bank enumerates.
 - **Baseline** (`--baseline check|update`): the program-baseline tier
   (DP300-DP304) — fingerprints + static cost vectors for every registered
   entry point, diffed against the checked-in `analysis/baselines.json`
@@ -27,8 +33,9 @@ error; `run_tests.sh` gates on it):
 
 Output: one `path:line:col: DPxxx message` line per finding on stdout
 (`--format json` swaps in one JSON object per line for CI and the report
-tooling); the human summary goes to stderr so the finding stream stays
-machine-parseable either way.
+tooling; `--format sarif` emits one SARIF 2.1.0 document over the whole
+finding set — all six wings share the serializer); the human summary goes
+to stderr so the finding stream stays machine-parseable either way.
 """
 
 from __future__ import annotations
@@ -66,9 +73,10 @@ def build_parser() -> argparse.ArgumentParser:
                     "DP101-DP108 + concurrency rules DP500-DP504 "
                     "(default), the concurrency wing alone "
                     "(--concurrency), the jaxpr-level program auditor "
-                    "DP200-DP206 (--trace), and the program-baseline "
-                    "drift gate DP300-DP304 (--baseline); see "
-                    "--list-rules")
+                    "DP200-DP206 (--trace), the sharding/collectives "
+                    "auditor DP600-DP603 (--comms), and the "
+                    "program-baseline drift gate DP300-DP304 "
+                    "(--baseline); see --list-rules")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories to lint (default: "
                         f"{' '.join(DEFAULT_PATHS)}; ignored under --trace)")
@@ -78,9 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print the rule table (AST + trace) and exit")
     p.add_argument("--fixable", action="store_true",
                    help="list only mechanically fixable offenses")
-    p.add_argument("--format", choices=("human", "json"), default="human",
+    p.add_argument("--format", choices=("human", "json", "sarif"),
+                   default="human",
                    help="finding output format: human `path:line:col:` "
-                        "lines (default) or one JSON object per line")
+                        "lines (default), one JSON object per line, or "
+                        "one SARIF 2.1.0 document over the whole set")
     p.add_argument("--concurrency", action="store_true",
                    help="run only the lock-discipline rules (DP500-DP504) "
                         "over the target paths — the concurrency gate "
@@ -88,6 +98,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace", action="store_true",
                    help="audit the registered jit entry points at the "
                         "jaxpr level (DP2xx) instead of linting source")
+    p.add_argument("--comms", action="store_true",
+                   help="audit the registered jit entry points for "
+                        "sharding/collective hazards (DP600-DP603): "
+                        "unpriced collectives, accidental replication, "
+                        "boundary reshards, shard-unsafe kernels")
     p.add_argument("--entrypoints", default="",
                    help="--trace/--baseline source override, "
                         "`module:callable` returning a list of EntryPoints "
@@ -148,18 +163,72 @@ def _baseline_rule_table() -> List[tuple]:
     return [(rid, False, name, desc) for rid, name, desc in BASELINE_RULE_ROWS]
 
 
+def _comms_rule_table() -> List[tuple]:
+    """(id, fixable, name, description) for the comms rules — comms.py
+    keeps its jax imports inside rule bodies, same backend-neutral
+    contract as the trace table."""
+    from dorpatch_tpu.analysis.comms import all_comms_rules
+
+    return [(r.id, False, r.name, r.description) for r in all_comms_rules()]
+
+
 def list_rules(out=None) -> None:
     out = out if out is not None else sys.stdout
     rows = [(r.id, r.fixable, r.name, r.description) for r in all_rules()]
     rows += _trace_rule_table()
     rows += _baseline_rule_table()
+    rows += _comms_rule_table()
     for rid, fixable, name, description in sorted(rows):
         fix = "fixable" if fixable else "       "
         out.write(f"{rid}  {fix}  {name}: {description}\n")
 
 
+def sarif_report(findings: List[Finding]) -> str:
+    """One SARIF 2.1.0 document over a finding set: the single serializer
+    every mode's `--format sarif` goes through, with the rule metadata
+    (name/description) of whichever wings the findings reference."""
+    meta = {}
+    rows = [(r.id, r.name, r.description) for r in all_rules()]
+    for rid, _fx, name, desc in (_trace_rule_table() + _baseline_rule_table()
+                                 + _comms_rule_table()):
+        rows.append((rid, name, desc))
+    for rid, name, desc in rows:
+        meta.setdefault(rid, (name, desc))
+    used = sorted({f.rule_id for f in findings})
+    index = {rid: i for i, rid in enumerate(used)}
+    rules = [{"id": rid,
+              "name": meta.get(rid, (rid, ""))[0] or rid,
+              "shortDescription": {
+                  "text": meta.get(rid, ("", rid))[1] or rid}}
+             for rid in used]
+    results = [{
+        "ruleId": f.rule_id,
+        "ruleIndex": index[f.rule_id],
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{"physicalLocation": {
+            "artifactLocation": {"uri": f.path},
+            "region": {"startLine": max(f.line, 1),
+                       "startColumn": max(f.col, 1)}}}],
+    } for f in findings]
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "dorpatch-analysis",
+                                "rules": rules}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=1, sort_keys=True) + "\n"
+
+
 def emit(findings: List[Finding], fmt: str, out=None) -> None:
     out = out if out is not None else sys.stdout
+    if fmt == "sarif":
+        out.write(sarif_report(findings))
+        return
     for f in findings:
         if fmt == "json":
             out.write(json.dumps(
@@ -180,6 +249,7 @@ def _parse_select(raw: str, mode: str) -> Optional[List[str]]:
         return None
     select = [s.strip().upper() for s in raw.split(",") if s.strip()]
     from dorpatch_tpu.analysis.baseline import BASELINE_RULE_IDS
+    from dorpatch_tpu.analysis.comms import COMMS_RULE_IDS
     from dorpatch_tpu.analysis.concurrency import CONCURRENCY_RULE_IDS
     from dorpatch_tpu.analysis.program import TRACE_RULE_IDS
 
@@ -187,6 +257,7 @@ def _parse_select(raw: str, mode: str) -> Optional[List[str]]:
         "lint": {r.id for r in all_rules()} | {"DP000"},
         "concurrency": set(CONCURRENCY_RULE_IDS) | {"DP000"},
         "trace": set(TRACE_RULE_IDS),
+        "comms": set(COMMS_RULE_IDS),
         "baseline": set(BASELINE_RULE_IDS),
     }
     bad = set(select) - wings[mode]
@@ -266,6 +337,28 @@ def _run_trace(select: Optional[List[str]], spec: str,
     return 0
 
 
+def _run_comms(select: Optional[List[str]], spec: str, fmt: str) -> int:
+    from dorpatch_tpu.analysis import comms
+
+    loaded = _load_entrypoints(spec)
+    if loaded is None:
+        return 2
+    eps, _, _, _ = loaded
+    findings = comms.audit_entrypoints(eps, select=select)
+    n_progs = len(eps)
+    emit(findings, fmt)
+    if findings:
+        sys.stderr.write(
+            f"{len(findings)} comms finding(s) across {n_progs} entry "
+            "point(s). Suppress a deliberate one with `# noqa: DP6xx` on "
+            "the program's def line, or a reasoned "
+            "analysis.comms.ALLOWLIST entry when no source line can own "
+            "it.\n")
+        return 1
+    sys.stderr.write(f"comms audit: {n_progs} entry point(s) clean\n")
+    return 0
+
+
 def _run_baseline(mode: str, select: Optional[List[str]], spec: str,
                   fmt: str, cost: str, file_override: str,
                   report_dir: str, allow_remove: bool = False) -> int:
@@ -280,6 +373,24 @@ def _run_baseline(mode: str, select: Optional[List[str]], spec: str,
             else baseline.baseline_path())
 
     if mode == "update":
+        old = baseline.load_baseline(path)
+        mesh_entries = sorted(n for n in (old or {}).get("entries", {})
+                              if ".mesh" in n)
+        if mesh_entries:
+            import jax
+
+            if jax.device_count() < 2:
+                # the .mesh program bank (and its comm_bytes vectors) only
+                # enumerates on a multi-device topology; writing here would
+                # silently strip it and its comm baselines from the gate
+                sys.stderr.write(
+                    f"--baseline update: {len(mesh_entries)} baselined "
+                    ".mesh entry point(s) cannot be enumerated on a "
+                    f"{jax.device_count()}-device host (e.g. "
+                    f"{mesh_entries[0]}). Re-run under XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=8; baseline "
+                    "NOT written\n")
+                return 2
         data, findings = baseline.build_baseline(eps, compiled=compiled)
         if findings:
             # a baseline with holes would make every later check vacuous
@@ -289,7 +400,6 @@ def _run_baseline(mode: str, select: Optional[List[str]], spec: str,
                 f"--baseline update: {len(findings)} entry point(s) failed "
                 "to trace; baseline NOT written\n")
             return 1
-        old = baseline.load_baseline(path)
         removed = sorted(set((old or {}).get("entries", {}))
                          - set(data.get("entries", {})))
         if removed and not allow_remove:
@@ -353,9 +463,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.list_rules:
         list_rules()
         return 0
-    # --baseline outranks --trace so `dorpatch-audit --baseline` (which
-    # prepends --trace) reaches the baseline tier
+    # --baseline and --comms outrank --trace so `dorpatch-audit --baseline`
+    # / `dorpatch-audit --comms` (which prepend --trace) reach their tiers
     mode = ("baseline" if args.baseline
+            else "comms" if args.comms
             else "trace" if args.trace
             else "concurrency" if args.concurrency else "lint")
     select = _parse_select(args.select, mode)
@@ -364,13 +475,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.diff and not args.fix:
         sys.stderr.write("--diff requires --fix\n")
         return 2
-    if args.fix and (args.trace or args.baseline or args.concurrency):
-        sys.stderr.write("--fix and --trace/--baseline/--concurrency are "
-                         "separate modes; run them as two invocations\n")
+    if args.fix and (args.trace or args.baseline or args.concurrency
+                     or args.comms):
+        sys.stderr.write("--fix and --trace/--baseline/--comms/"
+                         "--concurrency are separate modes; run them as "
+                         "two invocations\n")
         return 2
-    if args.concurrency and (args.trace or args.baseline):
+    if args.concurrency and (args.trace or args.baseline or args.comms):
         sys.stderr.write("--concurrency is a lint-side mode; run it "
-                         "separately from --trace/--baseline\n")
+                         "separately from --trace/--baseline/--comms\n")
         return 2
     paths = args.paths or default_paths()
     if args.fix:
@@ -380,6 +493,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                              args.format, args.baseline_cost,
                              args.baseline_file, args.baseline_report,
                              args.allow_remove)
+    if args.comms:
+        return _run_comms(select, args.entrypoints, args.format)
     if args.trace:
         return _run_trace(select, args.entrypoints, args.format)
     if args.concurrency and select is None:
@@ -407,8 +522,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 def audit_main(argv: Optional[List[str]] = None) -> int:
     """`dorpatch-audit` console script: the trace audit as a first-class
     command (`dorpatch-audit` == `python -m dorpatch_tpu.analysis --trace`).
-    `dorpatch-audit --baseline [check|update]` reaches the baseline tier:
-    --baseline outranks the prepended --trace."""
+    `dorpatch-audit --baseline [check|update]` reaches the baseline tier
+    and `dorpatch-audit --comms` the comms tier: both outrank the
+    prepended --trace."""
     return main(["--trace"] + list(argv if argv is not None else sys.argv[1:]))
 
 

@@ -1,0 +1,494 @@
+"""Sharding & collectives audit tier: the DP6xx comm-cost rule family.
+
+The trace tier (DP2xx) proves single-program invariants and the baseline
+tier (DP3xx) catches cost drift — but neither *prices communication*. On a
+mesh the dominant regression mode is not flops, it is a collective that
+quietly grows (an `all_gather` that used to be a `reduce_scatter`, a
+`psum` whose operand doubled) or a kernel that silently stops being
+shard-local. This module closes that hole at the jaxpr level, over the
+same registered production entry points the other wings audit:
+
+- **DP600 unpriced-collective** — the static comm pricer walks every
+  collective (`psum` family, `all_gather`, `reduce_scatter`,
+  `all_to_all`, `ppermute`, ...) including inside `shard_map` / pmap /
+  scan bodies (trip-count-scaled like the DP301 estimator) and prices
+  bytes as `operand-aval bytes x participant count` (the product of the
+  enclosing bound mesh-axis sizes). A collective the pricer *cannot*
+  price — an axis no enclosing mesh binds, or an `axis_index_groups`
+  partition whose group sizes the mesh product does not describe — is a
+  hole in the comm baseline and fires this rule. The priced inventory
+  itself is not a finding: it is the `comm_bytes` vector the baseline
+  tier folds into every entry's cost record, so DP301 catches comm
+  regressions exactly like flop regressions (naming the dominant
+  collective).
+- **DP601 accidental-replication** — a `shard_map` operand or result
+  above the byte threshold whose `in_names`/`out_names` entry is empty
+  (fully replicated) while a size>1 mesh axis divides its leading dim:
+  the tensor *could* shard but every device holds all of it. Replicated
+  small operands (weights, rect tables) are the intended idiom and stay
+  quiet.
+- **DP602 boundary-reshard** — conflicting placement constraints on one
+  value: a `sharding_constraint` whose input is itself a
+  `sharding_constraint` with a different spec (a chained re-pin), or one
+  value consumed under two different constraint specs — either way the
+  runtime inserts an implicit reshard at dispatch.
+- **DP603 shard-unsafe-kernel** — the shard-local kernel proof. In a
+  mesh program (one that contains a `shard_map`, or a `.mesh`-tagged
+  entry point), a `pallas_call` is mesh-safe iff it sits under a
+  `shard_map` whose body feeds it no collective results: the per-shard
+  trace then guarantees the grid derives only from shard-local shapes,
+  and GSPMD never sees the kernel. A *bare* `pallas_call` reachable
+  under a mesh is a custom call GSPMD cannot partition (it runs
+  replicated or fails to lower on device); a collective result flowing
+  into kernel *operands* means the kernel consumes cross-shard data and
+  the shard-local claim is false. Collectives consuming kernel *outputs*
+  (the masked-fill backward `psum`) are the clean pattern and pass.
+
+Findings flow through the engine types (`engine.Finding`, `# noqa:` on
+the entry point's `def` line, a reasoned `comms.ALLOWLIST` for offenses
+no source comment can reach) and the shared exit contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import (Any, Dict, Iterable, Iterator, List, Optional, Sequence,
+                    Set, Tuple)
+
+from dorpatch_tpu.analysis.engine import Finding
+from dorpatch_tpu.analysis.entrypoints import EntryPoint
+from dorpatch_tpu.analysis import program as program_mod
+from dorpatch_tpu.analysis.program import (ProgramContext, TraceRule,
+                                           _COLLECTIVE_PRIMS,
+                                           _collective_axes, _eqn_subjaxprs,
+                                           _raw)
+
+#: Entry-point-name glob -> {rule_id: reason} — the comms tier's analog of
+#: `program.ALLOWLIST`. Shipped entries must carry their reason.
+ALLOWLIST: Dict[str, Dict[str, str]] = {}
+
+#: Collectives that move payload; `axis_index` reads a mesh coordinate and
+#: transfers nothing.
+_PRICED_PRIMS = frozenset(_COLLECTIVE_PRIMS) - {"axis_index"}
+
+#: DP601 default: a replicated shard_map operand/result this large, with a
+#: shardable leading dim, is memory the mesh buys nothing for. Weight/table
+#: replication (small, deliberate) stays under it.
+REPLICATION_BYTES_THRESHOLD = 256 * 1024
+
+
+# -------------------------------------------------------------- comm pricer
+
+@dataclasses.dataclass
+class CommCost:
+    """Static comm vector for one program: total priced bytes, the
+    per-collective breakdown (the baseline's `comm` record), and the
+    (primitive, reason) list of collectives the pricer could not price."""
+
+    comm_bytes: float = 0.0
+    by_collective: Dict[str, float] = dataclasses.field(default_factory=dict)
+    unpriced: List[Tuple[str, str]] = dataclasses.field(default_factory=list)
+
+
+def _operand_bytes(eqn) -> float:
+    """Bytes of every non-literal operand aval, once each — the per-shard
+    payload one participant contributes to the collective."""
+    import jax
+
+    total = 0
+    for v in eqn.invars:
+        if isinstance(v, jax.core.Literal):
+            continue
+        a = getattr(v, "aval", None)
+        if a is None or not hasattr(a, "shape"):
+            continue
+        n = 1
+        for d in a.shape:
+            n *= int(d)
+        total += n * int(getattr(a.dtype, "itemsize", 4))
+    return float(total)
+
+
+def comm_cost(closed_or_raw) -> Dict[str, Any]:
+    """Walk a jaxpr and price every collective: `operand bytes x the
+    product of the bound sizes of its axes` (every participant contributes
+    its shard once — one uniform model across psum/all_gather/
+    reduce_scatter/all_to_all/ppermute, deliberately coarse: the vector
+    exists to rank collectives and catch step-function regressions, not to
+    model a ring schedule). Scan bodies multiply by trip count, mirroring
+    the DP301 flop estimator; `while` bodies count once. Axis sizes come
+    from the enclosing `shard_map` mesh / `pmap` axis_size; GSPMD-inserted
+    collectives live only in post-SPMD HLO and are out of scope by
+    construction — a meshed-jit program with zero explicit collectives
+    correctly prices to zero."""
+    acc = CommCost()
+    _walk_comm(closed_or_raw, 1.0, {}, acc)
+    acc.by_collective = dict(sorted(acc.by_collective.items(),
+                                    key=lambda kv: (-kv[1], kv[0])))
+    return {"comm_bytes": acc.comm_bytes,
+            "by_collective": acc.by_collective,
+            "unpriced": list(acc.unpriced)}
+
+
+def _walk_comm(j, mult: float, bound: Dict[str, int], acc: CommCost) -> None:
+    for eqn in _raw(j).eqns:
+        prim = eqn.primitive.name
+        if prim in _PRICED_PRIMS:
+            axes = _collective_axes(eqn)
+            groups = eqn.params.get("axis_index_groups")
+            if groups is not None:
+                acc.unpriced.append(
+                    (prim, "axis_index_groups partition the axis into "
+                           "groups the mesh-axis product does not price"))
+            else:
+                participants = 1.0
+                missing = [ax for ax in axes if ax not in bound]
+                if missing:
+                    acc.unpriced.append(
+                        (prim, f"axis {missing[0]!r} is not bound by any "
+                               "enclosing shard_map/pmap mesh"))
+                else:
+                    for ax in axes:
+                        participants *= float(bound[ax])
+                    priced = _operand_bytes(eqn) * participants * mult
+                    acc.comm_bytes += priced
+                    acc.by_collective[prim] = \
+                        acc.by_collective.get(prim, 0.0) + priced
+        inner_bound = bound
+        if prim == "shard_map":
+            mesh = eqn.params.get("mesh")
+            names = tuple(getattr(mesh, "axis_names", ()) or ())
+            if names:
+                inner_bound = dict(bound)
+                for n in names:
+                    try:
+                        inner_bound[n] = int(mesh.shape[n])
+                    except Exception:
+                        pass
+        elif prim == "xla_pmap":
+            name = eqn.params.get("axis_name")
+            size = eqn.params.get("axis_size")
+            if isinstance(name, str) and size:
+                inner_bound = dict(bound)
+                inner_bound[name] = int(size)
+        sub_mult = mult
+        if prim == "scan":
+            sub_mult = mult * float(eqn.params.get("length", 1) or 1)
+        for sub in _eqn_subjaxprs(eqn):
+            _walk_comm(sub, sub_mult, inner_bound, acc)
+
+
+# ----------------------------------------------------------------- registry
+
+_COMMS_REGISTRY: Dict[str, TraceRule] = {}
+
+
+def register_comms(cls):
+    if not cls.id:
+        raise ValueError(f"comms rule {cls.__name__} has no id")
+    if cls.id in _COMMS_REGISTRY:
+        raise ValueError(f"duplicate comms rule id {cls.id}")
+    _COMMS_REGISTRY[cls.id] = cls()
+    return cls
+
+
+def all_comms_rules() -> List[TraceRule]:
+    return [_COMMS_REGISTRY[k] for k in sorted(_COMMS_REGISTRY)]
+
+
+# -------------------------------------------------------------------- DP600
+
+@register_comms
+class UnpricedCollectiveRule(TraceRule):
+    id = "DP600"
+    name = "unpriced-collective"
+    description = ("collective the static comm pricer cannot price (axis "
+                   "bound by no enclosing mesh, or an axis_index_groups "
+                   "partition) — a hole in the comm_bytes baseline vector")
+
+    def check(self, ctx: ProgramContext) -> Iterator[Finding]:
+        cost = comm_cost(ctx.jaxpr)
+        for prim, why in cost["unpriced"]:
+            yield self.finding(
+                ctx, f"`{prim}` cannot be statically priced: {why} — the "
+                "entry's comm_bytes baseline vector under-counts this "
+                "collective, so DP301 cannot gate its regressions")
+
+
+# -------------------------------------------------------------------- DP601
+
+def _leading_divisible(aval, mesh) -> Optional[str]:
+    """The name of a size>1 mesh axis that divides the aval's leading dim
+    (preferring the conventional data axis), or None."""
+    shape = getattr(aval, "shape", ())
+    if not shape:
+        return None
+    lead = int(shape[0])
+    names = tuple(getattr(mesh, "axis_names", ()) or ())
+    ordered = sorted(names, key=lambda n: (n != "data", n))
+    for n in ordered:
+        try:
+            size = int(mesh.shape[n])
+        except Exception:
+            continue
+        if size > 1 and lead >= size and lead % size == 0:
+            return n
+    return None
+
+
+def _aval_nbytes(a) -> int:
+    n = 1
+    for d in getattr(a, "shape", ()):
+        n *= int(d)
+    return n * int(getattr(getattr(a, "dtype", None), "itemsize", 4) or 4)
+
+
+@register_comms
+class AccidentalReplicationRule(TraceRule):
+    id = "DP601"
+    name = "accidental-replication"
+    description = ("large shard_map operand/result fully replicated "
+                   "(empty in_names/out_names entry) while a size>1 mesh "
+                   "axis divides its leading dim — every device holds all "
+                   "of a tensor that could shard")
+
+    threshold = REPLICATION_BYTES_THRESHOLD
+
+    def check(self, ctx: ProgramContext) -> Iterator[Finding]:
+        import jax
+
+        for j in program_mod.iter_jaxprs(ctx.jaxpr):
+            for eqn in _raw(j).eqns:
+                if eqn.primitive.name != "shard_map":
+                    continue
+                mesh = eqn.params.get("mesh")
+                if mesh is None:
+                    continue
+                for side, vs, names in (
+                        ("operand", eqn.invars,
+                         eqn.params.get("in_names", ())),
+                        ("result", eqn.outvars,
+                         eqn.params.get("out_names", ()))):
+                    for i, (v, nm) in enumerate(zip(vs, names)):
+                        if nm:  # any dim mapped to an axis: not replicated
+                            continue
+                        a = getattr(v, "aval", None)
+                        if a is None or not hasattr(a, "shape"):
+                            continue
+                        nbytes = _aval_nbytes(a)
+                        if nbytes < self.threshold:
+                            continue
+                        axis = _leading_divisible(a, mesh)
+                        if axis is None:
+                            continue
+                        yield self.finding(
+                            ctx, f"shard_map {side} {i} "
+                            f"({program_mod._aval_str(a)}, "
+                            f"{nbytes / 1024:.0f} KiB) is fully replicated "
+                            f"but mesh axis {axis!r} divides its leading "
+                            f"dim — shard it (P({axis!r})) or shrink it "
+                            "below the replication threshold")
+
+
+# -------------------------------------------------------------------- DP602
+
+def _spec_str(sharding) -> str:
+    spec = getattr(sharding, "spec", None)
+    return str(spec if spec is not None else sharding)
+
+
+@register_comms
+class BoundaryReshardRule(TraceRule):
+    id = "DP602"
+    name = "boundary-reshard"
+    description = ("conflicting sharding_constraint specs pinned on one "
+                   "value (chained re-pin, or one value consumed under "
+                   "two placements) — the runtime inserts an implicit "
+                   "reshard at dispatch")
+
+    def check(self, ctx: ProgramContext) -> Iterator[Finding]:
+        for j in program_mod.iter_jaxprs(ctx.jaxpr):
+            yield from self._check_body(ctx, _raw(j))
+
+    def _check_body(self, ctx: ProgramContext, j) -> Iterator[Finding]:
+        import jax
+
+        producer: Dict[Any, Any] = {}
+        pinned: Dict[Any, str] = {}
+        for eqn in j.eqns:
+            if eqn.primitive.name == "sharding_constraint":
+                spec = _spec_str(eqn.params.get("sharding"))
+                src = eqn.invars[0]
+                if not isinstance(src, jax.core.Literal):
+                    prev = producer.get(src)
+                    if prev is not None and \
+                            prev.primitive.name == "sharding_constraint":
+                        prev_spec = _spec_str(prev.params.get("sharding"))
+                        if prev_spec != spec:
+                            yield self.finding(
+                                ctx, "chained sharding constraints re-pin "
+                                f"one value from {prev_spec} to {spec} — "
+                                "an implicit reshard at dispatch; keep one "
+                                "placement per value")
+                    seen = pinned.get(src)
+                    if seen is not None and seen != spec:
+                        yield self.finding(
+                            ctx, "one value is consumed under two "
+                            f"placements ({seen} and {spec}) — the "
+                            "runtime resolves the conflict with an "
+                            "implicit reshard; pick one spec")
+                    pinned.setdefault(src, spec)
+            for v in eqn.outvars:
+                if not isinstance(v, jax.core.DropVar):
+                    producer[v] = eqn
+
+
+# -------------------------------------------------------------------- DP603
+
+def _has_shard_map(closed_or_raw) -> bool:
+    for j in program_mod.iter_jaxprs(closed_or_raw):
+        for eqn in _raw(j).eqns:
+            if eqn.primitive.name == "shard_map":
+                return True
+    return False
+
+
+@register_comms
+class ShardLocalKernelRule(TraceRule):
+    id = "DP603"
+    name = "shard-unsafe-kernel"
+    description = ("pallas_call in a mesh program outside any shard_map "
+                   "(a custom call GSPMD cannot partition), or fed a "
+                   "collective result inside one (the kernel consumes "
+                   "cross-shard data) — the shard-local proof fails")
+
+    def check(self, ctx: ProgramContext) -> Iterator[Finding]:
+        if ".mesh" not in ctx.name and not _has_shard_map(ctx.jaxpr):
+            return  # single-chip program: kernels face no partitioner
+        yield from self._walk(ctx, ctx.jaxpr)
+
+    def _walk(self, ctx: ProgramContext, j) -> Iterator[Finding]:
+        """Above any shard_map: a pallas_call here is bare under the mesh.
+        At each shard_map: switch to the taint walk of its body."""
+        for eqn in _raw(j).eqns:
+            prim = eqn.primitive.name
+            if prim == "pallas_call":
+                yield self.finding(
+                    ctx, f"bare pallas_call ({self._kernel_name(eqn)}) "
+                    "reachable under a mesh outside any shard_map — GSPMD "
+                    "cannot partition a custom call; wrap it in shard_map "
+                    "over the data axis (the shard-local proof)")
+            if prim == "shard_map":
+                for sub in _eqn_subjaxprs(eqn):
+                    fs, _ = self._taint_body(ctx, sub, False)
+                    yield from fs
+            else:
+                for sub in _eqn_subjaxprs(eqn):
+                    yield from self._walk(ctx, sub)
+
+    def _taint_body(self, ctx: ProgramContext, j, in_taint: bool
+                    ) -> Tuple[List[Finding], bool]:
+        """Inside a shard_map body: taint = transitively derived from a
+        collective result. A tainted pallas_call operand breaks the
+        shard-local proof; a collective consuming kernel *output* (the
+        masked-fill backward psum) never taints the kernel and passes."""
+        import jax
+
+        j = _raw(j)
+        findings: List[Finding] = []
+        tainted: Set[Any] = set()
+        if in_taint:
+            tainted.update(j.invars)
+        for eqn in j.eqns:
+            prim = eqn.primitive.name
+            t_in = any(not isinstance(v, jax.core.Literal) and v in tainted
+                       for v in eqn.invars)
+            sub_taint = False
+            if prim == "pallas_call":
+                if t_in:
+                    findings.append(self.finding(
+                        ctx, f"pallas_call ({self._kernel_name(eqn)}) "
+                        "inside shard_map consumes a collective result — "
+                        "its operands are not shard-local; move the "
+                        "collective after the kernel or split the body"))
+            else:
+                for sub in _eqn_subjaxprs(eqn):
+                    fs, to = self._taint_body(ctx, sub, t_in)
+                    findings.extend(fs)
+                    sub_taint = sub_taint or to
+            if prim in _COLLECTIVE_PRIMS or t_in or sub_taint:
+                tainted.update(v for v in eqn.outvars
+                               if not isinstance(v, jax.core.DropVar))
+        out_taint = any(not isinstance(v, jax.core.Literal) and v in tainted
+                        for v in j.outvars)
+        return findings, out_taint
+
+    @staticmethod
+    def _kernel_name(eqn) -> str:
+        info = eqn.params.get("name_and_src_info")
+        name = getattr(info, "name", None) or eqn.params.get("name")
+        return str(name) if name else "<kernel>"
+
+
+# ------------------------------------------------------------------- driver
+
+def audit_entrypoint(ep: EntryPoint,
+                     select: Optional[Sequence[str]] = None,
+                     allow: Optional[Dict[str, Dict[str, str]]] = None
+                     ) -> List[Finding]:
+    """Trace one entry point (shared with the DP2xx tier) and run the
+    comms rules. An untraceable program is the trace wing's DP200 story —
+    here it simply contributes nothing (the trace gate fails loudly)."""
+    ctx, _ = program_mod.trace_entrypoint(ep)
+    findings: List[Finding] = []
+    if ctx is not None:
+        for rule in all_comms_rules():
+            if select is not None and rule.id not in select:
+                continue
+            findings.extend(rule.check(ctx))
+    out = []
+    for f in findings:
+        if select is not None and f.rule_id not in select:
+            continue
+        if _allowed(ep.name, f.rule_id, allow):
+            continue
+        if program_mod._suppressed_in_source(f.path, f.line, f.rule_id):
+            continue
+        out.append(f)
+    return sorted(out)
+
+
+def _allowed(name: str, rule_id: str,
+             allow: Optional[Dict[str, Dict[str, str]]] = None) -> bool:
+    import fnmatch
+
+    for table in (ALLOWLIST, allow or {}):
+        for pattern, rules in table.items():
+            if fnmatch.fnmatchcase(name, pattern) and rule_id in rules:
+                return True
+    return False
+
+
+def audit_entrypoints(eps: Iterable[EntryPoint],
+                      select: Optional[Sequence[str]] = None,
+                      allow: Optional[Dict[str, Dict[str, str]]] = None
+                      ) -> List[Finding]:
+    findings: List[Finding] = []
+    for ep in eps:
+        findings.extend(audit_entrypoint(ep, select=select, allow=allow))
+    return sorted(findings)
+
+
+def audit_production(select: Optional[Sequence[str]] = None,
+                     allow: Optional[Dict[str, Dict[str, str]]] = None
+                     ) -> List[Finding]:
+    """Enumerate + audit every registered production entry point — the
+    `--comms` gate's whole job."""
+    from dorpatch_tpu.analysis import entrypoints as ep_mod
+
+    eps = ep_mod.production_entrypoints()
+    return audit_entrypoints(eps, select=select, allow=allow)
+
+
+#: Rule IDs the comms wing owns.
+COMMS_RULE_IDS: Tuple[str, ...] = tuple(sorted(_COMMS_REGISTRY))

@@ -472,6 +472,49 @@ def _enumerate_kernel_tier() -> None:
                 name=f"ops.kernel_tier.{kname}.phase1.{tag}")
 
 
+def _enumerate_kernel_tier_mesh() -> None:
+    """The kernel-tier probes' meshed twins: the same stem/token phase-1
+    programs with the gate forced to "interpret" AND a (2, n/2) mesh
+    passed down, so the `pallas_call`s trace inside their `shard_map`
+    wrappers — the exact programs the DP603 shard-local proof certifies,
+    and the `.mesh`-tagged baseline entries whose comm_bytes vector pins
+    the wrappers' zero-collective claim. Enumerated only on an even
+    multi-device host, like `_enumerate_sharded_defense`."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.device_count() < 2 or jax.device_count() % 2:
+        return
+    from dorpatch_tpu import masks as masks_lib
+    from dorpatch_tpu.models import registry
+    from dorpatch_tpu.parallel import make_mesh
+
+    mesh = make_mesh(2, jax.device_count() // 2)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    dummy = jax.ShapeDtypeStruct(
+        (1, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    imgs = jax.ShapeDtypeStruct(
+        (AUDIT_BATCH, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    spec = masks_lib.geometry(AUDIT_IMG_SIZE, 0.06)
+    singles, doubles = masks_lib.mask_sets(spec)
+    k = max(singles.shape[1], doubles.shape[1])
+    rects = np.concatenate([masks_lib.pad_rects(singles, k),
+                            masks_lib.pad_rects(doubles, k)], axis=0)
+    for arch, kname in (("cifar_resnet18", "stem"), ("cifar_vit", "token")):
+        model = registry.build_bare_model(arch, AUDIT_CLASSES)
+        engine = registry.incremental_engine(arch, model, AUDIT_IMG_SIZE)
+        params_abs = abstractify(jax.eval_shape(model.init, key, dummy))
+        fam = engine.build_family(rects, singles.shape[0], 64, 0.5,
+                                  use_pallas="interpret", mesh=mesh)
+        # noqa-reason: audit-only probe programs, never executed — there
+        # is no run for their compile time to be accounted against
+        register_entrypoint(
+            jax.jit(fam.phase1),  # noqa: DP105
+            (params_abs, imgs),
+            name=f"ops.kernel_tier.{kname}.phase1.kernel.mesh")
+
+
 def _enumerate_sharded_ops() -> None:
     """The multichip dry-run path: the Pallas masked-fill gradient under
     `shard_map`, whose backward `psum`s over the mask axis — the one
@@ -524,6 +567,7 @@ def production_entrypoints(clear: bool = True) -> List[EntryPoint]:
         _enumerate_model_init()
         _enumerate_serve(apply_fn, params)
         _enumerate_kernel_tier()
+        _enumerate_kernel_tier_mesh()
         _enumerate_sharded_ops()
         _enumerate_sharded_defense(apply_fn, params)
     return registered_entrypoints()

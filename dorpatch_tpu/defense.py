@@ -957,15 +957,15 @@ class PatchCleanser:
         self._phase1_incr = self._pairs_incr = self._rows_incr = None
         if (self.incremental_engine is not None
                 and self.config.incremental != "off"):
-            # the engines' Pallas kernel tiers are single-chip (meshed
-            # programs go through GSPMD partitioning the raw pallas_call
-            # would break), so a meshed certifier pins the gate off and
-            # keeps the pure-XLA engine path — parity is trivial there
+            # meshed certifiers pass the mesh down: the engines' Pallas
+            # kernels run per data-axis shard under shard_map (the DP603
+            # shard-local proof — raw pallas_call is a custom call GSPMD
+            # cannot partition, so the wrappers bypass GSPMD entirely),
+            # and batches the data axis does not divide resolve "off"
             fam = self.incremental_engine.build_family(
                 np.asarray(self._rects), m, self.config.chunk_size,
                 self.config.mask_fill,
-                use_pallas=("off" if self.mesh is not None
-                            else self.config.use_pallas))
+                use_pallas=self.config.use_pallas, mesh=self.mesh)
             self._incr_family = fam
             kind = self.incremental_engine.kind
             self._phase1_incr = observe.timed_first_call(

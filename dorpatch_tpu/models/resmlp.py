@@ -201,15 +201,19 @@ class ResMLPMixerFamily:
 
     def __init__(self, engine: "MixerPrunedResMLP", rects: np.ndarray,
                  num_singles: int, chunk_size: int, fill: float,
-                 use_pallas: str = "auto"):
+                 use_pallas: str = "auto", mesh=None,
+                 data_axis: str = "data"):
         self.engine = engine
         self.num_singles = int(num_singles)
         self.chunk_size = max(1, int(chunk_size))
         self.fill = float(fill)
         # accepted for build_family signature parity with the kernel-tier
         # engines; the mixer's skinny [S, S] mix slice + dense dirty-row
-        # MLP is already plain matmuls XLA fuses — no Pallas tier (yet)
+        # MLP is already plain matmuls XLA fuses — no Pallas tier (yet),
+        # so the mesh rides plain GSPMD propagation and is recorded only
         self.use_pallas = use_pallas
+        self.mesh = mesh
+        self.data_axis = data_axis
         img, patch = engine.img_size, engine.patch
         self.first = _build_mixer_tables(rects[:num_singles], img, patch)
         self.pair_tables = _build_mixer_tables(rects[num_singles:], img,
@@ -261,9 +265,11 @@ class MixerPrunedResMLP:
 
     def build_family(self, rects: np.ndarray, num_singles: int,
                      chunk_size: int, fill: float,
-                     use_pallas: str = "auto") -> ResMLPMixerFamily:
+                     use_pallas: str = "auto", mesh=None,
+                     data_axis: str = "data") -> ResMLPMixerFamily:
         return ResMLPMixerFamily(self, rects, num_singles, chunk_size,
-                                 fill, use_pallas=use_pallas)
+                                 fill, use_pallas=use_pallas, mesh=mesh,
+                                 data_axis=data_axis)
 
     # ------------------------------------------------------------ internals
 

@@ -213,12 +213,15 @@ class TokenViTFamily:
 
     def __init__(self, engine: "TokenPrunedViT", rects: np.ndarray,
                  num_singles: int, chunk_size: int, fill: float,
-                 use_pallas: str = "auto"):
+                 use_pallas: str = "auto", mesh=None,
+                 data_axis: str = "data"):
         self.engine = engine
         self.num_singles = int(num_singles)
         self.chunk_size = max(1, int(chunk_size))
         self.fill = float(fill)
         self.use_pallas = use_pallas
+        self.mesh = mesh
+        self.data_axis = data_axis
         img, patch = engine.img_size, engine.patch
         self.first = _build_tables(rects[:num_singles], img, patch)
         self.pair_tables = _build_tables(rects[num_singles:], img, patch)
@@ -240,17 +243,20 @@ class TokenViTFamily:
     def phase1(self, params, imgs):
         return self.engine._table(params, imgs, self.first,
                                   self.fill, self.chunk_size,
-                                  self.use_pallas)
+                                  self.use_pallas, self.mesh,
+                                  self.data_axis)
 
     def pairs(self, params, imgs):
         return self.engine._table(params, imgs, self.pair_tables,
                                   self.fill, self.chunk_size,
-                                  self.use_pallas)
+                                  self.use_pallas, self.mesh,
+                                  self.data_axis)
 
     def rows(self, params, imgs_g, sets_idx):
         return self.engine._rows(params, imgs_g, sets_idx, self.combined,
                                  self.fill, self.chunk_size,
-                                 self.use_pallas)
+                                 self.use_pallas, self.mesh,
+                                 self.data_axis)
 
 
 class TokenPrunedViT:
@@ -277,9 +283,11 @@ class TokenPrunedViT:
 
     def build_family(self, rects: np.ndarray, num_singles: int,
                      chunk_size: int, fill: float,
-                     use_pallas: str = "auto") -> TokenViTFamily:
+                     use_pallas: str = "auto", mesh=None,
+                     data_axis: str = "data") -> TokenViTFamily:
         return TokenViTFamily(self, rects, num_singles, chunk_size, fill,
-                              use_pallas=use_pallas)
+                              use_pallas=use_pallas, mesh=mesh,
+                              data_axis=data_axis)
 
     # ------------------------------------------------------------ internals
 
@@ -331,7 +339,8 @@ class TokenPrunedViT:
                       + a["value"]["bias"])
         return tuple(ks), tuple(vs)
 
-    def _forward(self, params, d, kcs, vcs, idx, slot_bias, attn="off"):
+    def _forward(self, params, d, kcs, vcs, idx, slot_bias, attn="off",
+                 mesh=None, data_axis="data"):
         """Dirty tokens `d [B, C, S, D]` (C masks per image) through every
         block against the per-IMAGE clean KV caches (`kcs`/`vcs`:
         `depth x [B, T+1, H, hd]`). Attention concatenates two key/value
@@ -351,7 +360,10 @@ class TokenPrunedViT:
         off composes the attention read from einsums; otherwise the fused
         `ops.masked_kv_attn` kernel reads the cached K/V blocks in place
         with both biases folded into the logits on-chip (same math,
-        regrouped reductions — allclose, margin-contracted verdicts)."""
+        regrouped reductions — allclose, margin-contracted verdicts). On a
+        multi-device `mesh` the kernel runs per data-axis shard under
+        `shard_map` (`masked_kv_attention_sharded`, the DP603-proved
+        form)."""
         p = params["params"]
         t1 = kcs[0].shape[1]
         hd = self.module.dim // self.module.num_heads
@@ -382,6 +394,11 @@ class TokenPrunedViT:
                                    axis=-1)
                 o = jnp.einsum("bchst,bthf->bcshf", w[..., :t1], vcs[layer]) \
                     + jnp.einsum("bchst,bcthf->bcshf", w[..., t1:], vd)
+            elif mesh is not None and mesh.devices.size > 1:
+                o = masked_kv_attn.masked_kv_attention_sharded(
+                    q, kd, vd, kcs[layer], vcs[layer], stale_bias,
+                    slot_bias, mesh, data_axis,
+                    interpret=(attn == "interpret"))
             else:
                 o = masked_kv_attn.masked_kv_attention(
                     q, kd, vd, kcs[layer], vcs[layer], stale_bias,
@@ -402,7 +419,7 @@ class TokenPrunedViT:
         return preds_margins(logits)
 
     def _chunk(self, params, patches, cls0, kcs, vcs, idxc, keepc, biasc,
-               fill, attn="off"):
+               fill, attn="off", mesh=None, data_axis="data"):
         """One mask chunk: [B images, c masks] dirty-token batch against
         the per-image clean KV caches (shared across the mask axis — the
         einsums read them in place). Tables are PER-IMAGE (`[B, c, ...]`):
@@ -416,11 +433,24 @@ class TokenPrunedViT:
         emb = self._embed(params, pg, keepc, idxc[..., 1:], fill)
         cls = jnp.broadcast_to(cls0[:, None], (b, c, 1, dim))
         d = jnp.concatenate([cls, emb], axis=2)                 # [B, c, S, D]
-        logits = self._forward(params, d, kcs, vcs, idxc, biasc, attn)
+        logits = self._forward(params, d, kcs, vcs, idxc, biasc, attn,
+                               mesh, data_axis)
         return self._preds_margins(logits)                      # [B, c] each
 
+    @staticmethod
+    def _resolve_attn(use_pallas, mesh, data_axis, leading):
+        """The shared gate, with the mesh divisibility rule: a batch the
+        data axis does not divide falls back to the XLA einsum path (the
+        shard_map wrapper needs equal per-shard blocks)."""
+        on_mesh = (mesh is not None
+                   and getattr(mesh, "devices", None) is not None
+                   and mesh.devices.size > 1)
+        divisible = (not on_mesh) or leading % mesh.shape[data_axis] == 0
+        return _backend.resolve_use_pallas(use_pallas, mesh=mesh,
+                                           divisible=divisible)
+
     def _table(self, params, imgs, tables: _TokenTables, fill, chunk_size,
-               use_pallas: str = "off"):
+               use_pallas: str = "off", mesh=None, data_axis="data"):
         """All N masks of `tables` over the batch -> (preds, margins)
         `[B, N]`, scanning mask chunks of <= chunk_size (the same live-
         memory bound as `defense.masked_predictions`). Padding masks repeat
@@ -442,7 +472,7 @@ class TokenPrunedViT:
         cls0 = cache[0][:, :1]
         patches = self._patches(imgs)
         b = imgs.shape[0]
-        attn = _backend.resolve_use_pallas(use_pallas)
+        attn = self._resolve_attn(use_pallas, mesh, data_axis, b)
 
         def body(carry, xs):
             idxc, keepc, biasc = xs
@@ -452,7 +482,7 @@ class TokenPrunedViT:
 
             return carry, self._chunk(params, patches, cls0, kcs, vcs,
                                       bc(idxc), bc(keepc), bc(biasc), fill,
-                                      attn)
+                                      attn, mesh, data_axis)
 
         _, (preds, margins) = jax.lax.scan(body, None,
                                            (idx_p, keep_p, bias_p))
@@ -461,7 +491,8 @@ class TokenPrunedViT:
         return preds, margins
 
     def _rows(self, params, imgs_g, sets_idx, combined: _TokenTables, fill,
-              chunk_size, use_pallas: str = "off"):
+              chunk_size, use_pallas: str = "off", mesh=None,
+              data_axis="data"):
         """Ragged second-round rows: entry w = (gathered image, [M2] row of
         combined-table mask indices). The second-mask axis is processed in
         chunks of `max(1, chunk_size // W)` so each scan step is a
@@ -482,7 +513,7 @@ class TokenPrunedViT:
         kcs, vcs = self._clean_kv(params, cache)
         cls0 = cache[0][:, :1]
         patches = self._patches(imgs_g)
-        attn = _backend.resolve_use_pallas(use_pallas)
+        attn = self._resolve_attn(use_pallas, mesh, data_axis, w)
 
         def chunked(t):  # [W, M2p, ...] -> scan xs [nc, W, c, ...]
             return jnp.moveaxis(
@@ -491,7 +522,8 @@ class TokenPrunedViT:
         def body(carry, xs):
             idxc, keepc, biasc = xs           # [W, c, ...]
             return carry, self._chunk(params, patches, cls0, kcs, vcs,
-                                      idxc, keepc, biasc, fill, attn)
+                                      idxc, keepc, biasc, fill, attn,
+                                      mesh, data_axis)
 
         _, (preds, margins) = jax.lax.scan(
             body, None, (chunked(idx_all), chunked(keep_all),

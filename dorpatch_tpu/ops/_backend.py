@@ -32,6 +32,24 @@ def single_device_tpu() -> bool:
     return is_tpu_backend() and jax.device_count() == 1
 
 
+def get_shard_map():
+    """The `shard_map` entry point plus the kwargs that disable its
+    replication checker, across the jax versions this package supports.
+
+    Every sharded Pallas wrapper in the package (`masked_fill`, the
+    stem-fold and masked-KV attention kernels) builds its per-shard body
+    the same way; this is the one place the version split lives. Returns
+    `(shard_map, kwargs)` — splat `kwargs` into every call."""
+    try:
+        # jax >= 0.6: public API; the replication check kwarg is check_vma
+        from jax import shard_map
+        return shard_map, {"check_vma": False}
+    except ImportError:
+        # jax 0.4.x: experimental API, same semantics, kwarg is check_rep
+        from jax.experimental.shard_map import shard_map
+        return shard_map, {"check_rep": False}
+
+
 def resolve_use_pallas(use_pallas: str = "auto", *, mesh=None,
                        divisible: bool = True) -> str:
     """Resolve the shared `use_pallas` gate to `"on" | "off" | "interpret"`.

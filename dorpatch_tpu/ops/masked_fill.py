@@ -164,14 +164,7 @@ def _sharded_masked_fill_fn(fill: float, interpret: bool, mesh,
     forward wants. The backward kernel accumulates per-shard image cotangents
     and `psum`s them over the mask axis — the one collective this op needs.
     """
-    try:
-        # jax >= 0.6: public API; the replication check kwarg is check_vma
-        from jax import shard_map
-        sm_kwargs = {"check_vma": False}
-    except ImportError:
-        # jax 0.4.x: experimental API, same semantics, kwarg is check_rep
-        from jax.experimental.shard_map import shard_map
-        sm_kwargs = {"check_rep": False}
+    shard_map, sm_kwargs = _backend.get_shard_map()
     from jax.sharding import PartitionSpec as P
 
     im_spec = P(data_axis)             # [B,H,W,C]: data-sharded, mask-replicated

@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from dorpatch_tpu.ops import _backend
+
 
 def masked_kv_attention_reference(q, kd, vd, kc, vc, clean_bias, dirty_bias):
     """The einsum composition the kernel replaces (q pre-scaled):
@@ -103,3 +105,24 @@ def masked_kv_attention(q, kd, vd, kc, vc, clean_bias, dirty_bias,
         out_shape=jax.ShapeDtypeStruct((b, c, s, h, f), q.dtype),
         interpret=interpret,
     )(q, kd, vd, kc, vc, clean_bias, dirty_bias)
+
+
+def masked_kv_attention_sharded(q, kd, vd, kc, vc, clean_bias, dirty_bias,
+                                mesh, data_axis: str = "data",
+                                interpret: bool = False):
+    """`masked_kv_attention` under `shard_map` over the data axis — the
+    mesh-safe form the DP603 audit proves. Every operand carries the image
+    batch on its leading axis, so all seven shard `P(data)` together, the
+    per-shard grid is ([B/d], C) — shard-local in both dimensions — and
+    the body contains no collectives (attention entries are per-image;
+    nothing crosses shards). The output keeps the data-axis sharding the
+    surrounding block arithmetic propagates."""
+    shard_map, sm_kwargs = _backend.get_shard_map()
+    from jax.sharding import PartitionSpec as P
+
+    sm = shard_map(
+        functools.partial(masked_kv_attention, interpret=interpret),
+        mesh=mesh,
+        in_specs=(P(data_axis),) * 7,
+        out_specs=P(data_axis), **sm_kwargs)
+    return sm(q, kd, vd, kc, vc, clean_bias, dirty_bias)

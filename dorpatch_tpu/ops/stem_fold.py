@@ -265,6 +265,31 @@ def fold_masked_stem_kernel(kernel: jax.Array, clean: jax.Array,
     )(jnp.asarray(geo), up, jnp.asarray(occ), clean, kernel)
 
 
+def fold_masked_stem_sharded(kernel: jax.Array, clean: jax.Array,
+                             u: jax.Array, plan: Sequence[_Window],
+                             strides: Tuple[int, int], pads, mesh,
+                             data_axis: str = "data",
+                             interpret: bool = False) -> jax.Array:
+    """`fold_masked_stem_kernel` under `shard_map` over the data axis — the
+    mesh-safe form the DP603 audit proves: the stem kernel's grid iterates
+    (per-shard images) x (masks), both shard-local, and the body contains
+    no collectives at all (phase-1 entries are per-image, nothing crosses
+    shards). The effective stem kernel and the static plan are replicated;
+    the clean cache and fill-delta input shard with the image batch, so
+    each device folds only its `[B/d, N, h, w, c]` block."""
+    shard_map, sm_kwargs = _backend.get_shard_map()
+    from jax.sharding import PartitionSpec as P
+
+    body = functools.partial(fold_masked_stem_kernel, plan=plan,
+                             strides=strides, pads=pads,
+                             interpret=interpret)
+    sm = shard_map(lambda kern, cl, uu: body(kern, cl, uu),
+                   mesh=mesh,
+                   in_specs=(P(), P(data_axis), P(data_axis)),
+                   out_specs=P(data_axis), **sm_kwargs)
+    return sm(kernel, clean, u)
+
+
 def _preds_margins(logits):
     from dorpatch_tpu.utils import preds_margins
 
@@ -282,12 +307,15 @@ class StemFoldFamily:
 
     def __init__(self, engine: "StemFoldEngine", rects: np.ndarray,
                  num_singles: int, chunk_size: int, fill: float,
-                 use_pallas: str = "auto"):
+                 use_pallas: str = "auto", mesh=None,
+                 data_axis: str = "data"):
         self.engine = engine
         self.num_singles = int(num_singles)
         self.chunk_size = max(1, int(chunk_size))
         self.fill = float(fill)
         self.use_pallas = use_pallas
+        self.mesh = mesh
+        self.data_axis = data_axis
         self.plan = plan_windows(rects[:num_singles], engine.img_size,
                                  engine.kernel_hw, engine.strides[0],
                                  engine.pads)
@@ -316,16 +344,28 @@ class StemFoldFamily:
         # tail, no padding, no retrace).
         inflation = float(np.prod(clean.shape[1:])) / float(h * w * ci)
         c = max(1, min(n, int(self.chunk_size / max(1.0, inflation))))
-        # kernel tier: resolved at trace time by the shared gate (mesh=None
-        # — meshed certifiers pass use_pallas="off" down build_family, see
-        # defense._build_pruned_programs)
-        mode = _backend.resolve_use_pallas(self.use_pallas)
+        # kernel tier: resolved at trace time by the shared gate. On a
+        # multi-device mesh the kernel runs per shard under shard_map
+        # (`fold_masked_stem_sharded` — the DP603-proved form); batches the
+        # data axis does not divide fall back to the partitionable XLA fold.
+        mesh = self.mesh
+        on_mesh = (mesh is not None
+                   and getattr(mesh, "devices", None) is not None
+                   and mesh.devices.size > 1)
+        divisible = (not on_mesh) or b % mesh.shape[self.data_axis] == 0
+        mode = _backend.resolve_use_pallas(self.use_pallas, mesh=mesh,
+                                           divisible=divisible)
         preds, margins = [], []
         for off in range(0, n, c):
             part = self.plan[off:off + c]
             if mode == "off":
                 folded = fold_masked_stem(kernel, clean, u, part,
                                           eng.strides, eng.pads)
+            elif on_mesh:
+                folded = fold_masked_stem_sharded(
+                    kernel, clean, u, part, eng.strides, eng.pads,
+                    mesh, self.data_axis,
+                    interpret=(mode == "interpret"))  # [B, c', ...]
             else:
                 folded = fold_masked_stem_kernel(
                     kernel, clean, u, part, eng.strides, eng.pads,
@@ -368,6 +408,8 @@ class StemFoldEngine:
 
     def build_family(self, rects: np.ndarray, num_singles: int,
                      chunk_size: int, fill: float,
-                     use_pallas: str = "auto") -> StemFoldFamily:
+                     use_pallas: str = "auto", mesh=None,
+                     data_axis: str = "data") -> StemFoldFamily:
         return StemFoldFamily(self, rects, num_singles, chunk_size, fill,
-                              use_pallas=use_pallas)
+                              use_pallas=use_pallas, mesh=mesh,
+                              data_axis=data_axis)

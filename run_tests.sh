@@ -37,6 +37,16 @@ timeout -k 10 420 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -m dorpatch_tpu.analysis --baseline check || exit $?
 echo "program baseline (--baseline check): OK"
+# Gate 4: the sharding & collectives auditor (DP600-DP603) — prices every
+# explicit collective in every registered entry point (operand bytes x
+# mesh-axis size), flags unpriceable collectives, accidental replication,
+# boundary reshards, and any Pallas kernel a mesh program runs outside its
+# shard_map wrapper (the shard-local proof). Trace-only, same 8-device
+# virtual mesh as the trace gate.
+timeout -k 10 120 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m dorpatch_tpu.analysis --comms || exit $?
+echo "comms audit (--comms): OK"
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@" \
   || exit $?
 # Smoke: the offline telemetry report CLI must render the checked-in fixture

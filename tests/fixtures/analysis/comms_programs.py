@@ -1,0 +1,204 @@
+"""Fixture jit programs for the DP6xx sharding & collectives auditor: one
+deliberately broken builder per rule plus clean twins. Imported by
+`tests/test_comms_audit.py` and by the analysis CLI's `--entrypoints`
+override (exit-code tests run `python -m dorpatch_tpu.analysis --comms
+--entrypoints comms_programs:bad_entrypoints` with this directory on
+PYTHONPATH). Every builder needs a multi-device host (the test gate's
+8-device virtual CPU mesh).
+
+The DP603 clean twin is deliberately the *production* pattern, not a toy:
+`ops.masked_fill` under its mesh wrapper — the Pallas forward inside
+`shard_map`, the backward kernel whose *output* feeds the mask-axis
+`psum`. That is the shard-local proof the rule exists to certify; the
+positive twins plant the two ways the proof fails (a bare `pallas_call`
+under the mesh, and a collective result feeding kernel operands).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from dorpatch_tpu import ops
+from dorpatch_tpu.analysis.entrypoints import EntryPoint, abstractify
+
+
+def _ep(name, fn, *args):
+    return EntryPoint(name=name, fn=fn,
+                      args=tuple(abstractify(a) for a in args))
+
+
+def _mesh1d():
+    return Mesh(np.asarray(jax.devices()).reshape(-1), ("data",))
+
+
+def _mesh2d():
+    return Mesh(np.asarray(jax.devices()).reshape(1, -1), ("data", "mask"))
+
+
+_SM_KW = {"check_rep": False}
+
+
+def grouped_psum():
+    """DP600: a psum partitioned by axis_index_groups — the mesh-axis
+    product does not price its groups, so the comm vector has a hole."""
+    mesh = _mesh1d()
+    groups = [list(range(jax.device_count() // 2)),
+              list(range(jax.device_count() // 2, jax.device_count()))]
+    program = jax.jit(shard_map(
+        lambda x: lax.psum(x, "data", axis_index_groups=groups),
+        mesh=mesh, in_specs=P("data"), out_specs=P("data"), **_SM_KW))
+    return _ep("fx.grouped_psum", program,
+               jnp.zeros((jax.device_count(), 4)))
+
+
+def priced_psum():
+    """Clean twin of grouped_psum: a plain bound-axis psum prices exactly
+    (operand bytes x axis size) and fires nothing."""
+    mesh = _mesh1d()
+    program = jax.jit(shard_map(
+        lambda x: lax.psum(x, "data"), mesh=mesh,
+        in_specs=P("data"), out_specs=P(), **_SM_KW))
+    return _ep("fx.priced_psum", program, jnp.zeros((jax.device_count(), 4)))
+
+
+def replicated_operand():
+    """DP601: a 512 KiB shard_map operand fully replicated (P()) although
+    the size-8 data axis divides its leading dim."""
+    mesh = _mesh1d()
+    program = jax.jit(shard_map(
+        lambda big, y: y + big.sum(), mesh=mesh,
+        in_specs=(P(), P("data")), out_specs=P("data"), **_SM_KW))
+    big = jnp.zeros((jax.device_count(), 16384), jnp.float32)  # 512 KiB
+    return _ep("fx.replicated_operand", program, big,
+               jnp.zeros((jax.device_count(), 4)))
+
+
+def sharded_operand():
+    """Clean twin of replicated_operand: the large tensor shards P(data);
+    the small replicated scale tensor stays under the byte threshold (the
+    intended weight-replication idiom)."""
+    mesh = _mesh1d()
+    program = jax.jit(shard_map(
+        lambda big, s: big * s, mesh=mesh,
+        in_specs=(P("data"), P()), out_specs=P("data"), **_SM_KW))
+    big = jnp.zeros((jax.device_count(), 16384), jnp.float32)
+    return _ep("fx.sharded_operand", program, big,
+               jnp.zeros((16384,), jnp.float32))
+
+
+def chained_reshard():
+    """DP602: one value pinned to P(data) and immediately re-pinned to a
+    different placement — an implicit reshard at dispatch."""
+    mesh = _mesh2d()
+
+    @jax.jit
+    def program(x):
+        y = lax.with_sharding_constraint(x, NamedSharding(mesh, P("data")))
+        return lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P(None, "mask")))
+
+    return _ep("fx.chained_reshard", program, jnp.zeros((8, 8)))
+
+
+def single_pin():
+    """Clean twin of chained_reshard: one placement per value."""
+    mesh = _mesh2d()
+
+    @jax.jit
+    def program(x):
+        y = lax.with_sharding_constraint(x, NamedSharding(mesh, P("data")))
+        return y * 2.0
+
+    return _ep("fx.single_pin", program, jnp.zeros((8, 8)))
+
+
+def _add_one_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] + 1.0
+
+
+def _pallas_add_one(x):
+    return pl.pallas_call(
+        _add_one_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True)(x)
+
+
+def bare_kernel_under_mesh():
+    """DP603 (a): a mesh program (it contains a shard_map) whose
+    pallas_call sits OUTSIDE the shard_map — a custom call GSPMD cannot
+    partition."""
+    mesh = _mesh1d()
+    reduce_ = shard_map(lambda x: lax.psum(x, "data"), mesh=mesh,
+                        in_specs=P("data"), out_specs=P(), **_SM_KW)
+
+    @jax.jit
+    def program(x):
+        y = _pallas_add_one(x)  # bare: not under the shard_map
+        return reduce_(y)
+
+    return _ep("fx.bare_kernel_under_mesh", program, jnp.zeros((8, 8)))
+
+
+def collective_fed_kernel():
+    """DP603 (b): inside the shard_map, a psum result flows into the
+    kernel's operands — the kernel consumes cross-shard data, so the
+    shard-local proof fails."""
+    mesh = _mesh1d()
+
+    def body(x):
+        g = lax.psum(x, "data")
+        return _pallas_add_one(g)
+
+    program = jax.jit(shard_map(body, mesh=mesh, in_specs=P("data"),
+                                out_specs=P("data"), **_SM_KW))
+    return _ep("fx.collective_fed_kernel", program, jnp.zeros((8, 8)))
+
+
+def shard_local_kernel():
+    """DP603 clean proof, production pattern: `ops.masked_fill` under its
+    mesh wrapper — forward kernel per shard inside shard_map, backward
+    kernel whose OUTPUT feeds the mask-axis psum. Nothing crosses shards
+    before a kernel runs."""
+    mesh = _mesh2d()
+    n_masks = int(mesh.shape["mask"])
+
+    @jax.jit
+    def program(imgs, rects):
+        def total(im):
+            return ops.masked_fill(im, rects, 0.5, "interpret",
+                                   mesh=mesh).sum()
+
+        return jax.value_and_grad(total)(imgs)
+
+    return _ep("fx.shard_local_kernel", program,
+               jnp.zeros((2, 16, 16, 3)),
+               jnp.zeros((n_masks, 1, 4), jnp.int32))
+
+
+#: rule id -> (positive builder(s), clean twin)
+PER_RULE = {
+    "DP600": ((grouped_psum,), priced_psum),
+    "DP601": ((replicated_operand,), sharded_operand),
+    "DP602": ((chained_reshard,), single_pin),
+    "DP603": ((bare_kernel_under_mesh, collective_fed_kernel),
+              shard_local_kernel),
+}
+
+
+def bad_entrypoints():
+    """--entrypoints payload: every positive fixture (CLI must exit 1)."""
+    return [grouped_psum(), replicated_operand(), chained_reshard(),
+            bare_kernel_under_mesh(), collective_fed_kernel()]
+
+
+def clean_entrypoints():
+    """--entrypoints payload: only clean programs (CLI must exit 0)."""
+    return [priced_psum(), sharded_operand(), single_pin(),
+            shard_local_kernel()]

@@ -1,0 +1,293 @@
+"""The sharding & collectives auditor (analysis/comms.py, DP600-DP603):
+the static comm-cost model (bound-axis pricing, axis_index_groups holes,
+scan trip multipliers), per-rule positive/negative fixture programs
+including the production masked-fill mesh wrapper as the DP603 shard-local
+proof, the comm vector folded into the baseline tier (a planted collective
+regression must trip DP301 NAMING the collective), the `--baseline update`
+topology refusal, the shipped tree staying clean, suppression semantics,
+and the CLI `--comms` / `--format sarif` exit-code contract."""
+
+import json
+import pathlib
+import sys
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dorpatch_tpu.analysis import baseline, comms, program
+from dorpatch_tpu.analysis.cli import main as cli_main
+from dorpatch_tpu.analysis.entrypoints import EntryPoint, abstractify
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "analysis"
+sys.path.insert(0, str(FIXTURES))
+
+import comms_programs  # noqa: E402  (fixture module, see path insert)
+
+
+def rule_ids(findings):
+    return sorted({f.rule_id for f in findings})
+
+
+def traced(ep):
+    ctx, errs = program.trace_entrypoint(ep)
+    assert ctx is not None, errs
+    return ctx
+
+
+# ---------- the comm-cost model ----------
+
+def test_comm_cost_prices_bound_axis_psum():
+    """operand bytes x axis size, on the PER-SHARD aval: (n, 4) f32 over
+    an n-device data axis is a (1, 4) shard — 16 bytes x n participants."""
+    cc = comms.comm_cost(traced(comms_programs.priced_psum()).jaxpr)
+    n = jax.device_count()
+    assert cc["comm_bytes"] == 16 * n
+    assert cc["by_collective"] == {"psum": 16 * n}
+    assert not cc["unpriced"]
+
+
+def test_comm_cost_grouped_psum_is_unpriced():
+    cc = comms.comm_cost(traced(comms_programs.grouped_psum()).jaxpr)
+    assert cc["unpriced"], "axis_index_groups must leave a pricing hole"
+    assert any("axis_index_groups" in why for _, why in cc["unpriced"])
+
+
+def test_comm_cost_scan_multiplies_by_trip_count():
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = comms_programs._mesh1d()
+
+    def body(x):
+        def step(c, _):
+            return c + lax.psum(c, "data"), None
+
+        y, _ = lax.scan(step, x, None, length=5)
+        return y
+
+    ep = EntryPoint(
+        name="fx.scan_psum",
+        fn=jax.jit(shard_map(body, mesh=mesh, in_specs=P("data"),
+                             out_specs=P("data"), check_rep=False)),
+        args=(abstractify(jnp.zeros((jax.device_count(), 4))),))
+    cc = comms.comm_cost(traced(ep).jaxpr)
+    assert cc["comm_bytes"] == 5 * 16 * jax.device_count()
+
+
+def test_comm_cost_zero_for_collective_free_program():
+    ep = EntryPoint(name="fx.none", fn=jax.jit(lambda x: x * 2.0),
+                    args=(abstractify(jnp.zeros((4,))),))
+    cc = comms.comm_cost(traced(ep).jaxpr)
+    assert cc == {"comm_bytes": 0, "by_collective": {}, "unpriced": []}
+
+
+# ---------- per-rule positives / negatives ----------
+
+@pytest.mark.parametrize("rule_id", sorted(comms_programs.PER_RULE))
+def test_comms_rule_positive_fires(rule_id):
+    positives, _ = comms_programs.PER_RULE[rule_id]
+    for pos in positives:
+        findings = comms.audit_entrypoint(pos())
+        assert rule_id in rule_ids(findings), \
+            f"{rule_id} did not fire on {pos.__name__}: " \
+            f"{[f.render() for f in findings]}"
+
+
+@pytest.mark.parametrize("rule_id", sorted(comms_programs.PER_RULE))
+def test_comms_rule_negative_clean(rule_id):
+    _, neg = comms_programs.PER_RULE[rule_id]
+    findings = comms.audit_entrypoint(neg())
+    assert rule_id not in rule_ids(findings), \
+        f"false positive on {neg.__name__}: " \
+        f"{[f.render() for f in findings]}"
+
+
+def test_dp603_production_mesh_wrapper_is_the_clean_proof():
+    """The whole point of DP603: `ops.masked_fill` under its shard_map
+    wrapper — forward kernel per shard, backward kernel feeding (not fed
+    by) the mask-axis psum — audits completely clean."""
+    assert not comms.audit_entrypoint(comms_programs.shard_local_kernel())
+
+
+def test_dp603_names_the_kernel():
+    (f,) = [f for f in comms.audit_entrypoint(
+        comms_programs.bare_kernel_under_mesh())
+        if f.rule_id == "DP603"]
+    assert "pallas" in f.message.lower() or "kernel" in f.message.lower()
+
+
+# ---------- suppression ----------
+
+def test_comms_allowlist_glob_suppresses():
+    findings = comms.audit_entrypoint(
+        comms_programs.grouped_psum(),
+        allow={"fx.grouped_*": {"DP600": "fixture"}})
+    assert "DP600" not in rule_ids(findings)
+
+
+# ---------- the baseline fold: comm vectors + DP301 ----------
+
+def _psum_ep():
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = comms_programs._mesh1d()
+    fn = jax.jit(shard_map(lambda x: lax.psum(x, "data"), mesh=mesh,
+                           in_specs=P("data"), out_specs=P(),
+                           check_rep=False))
+    return EntryPoint(
+        name="fx.comm_reg", fn=fn,
+        args=(abstractify(jnp.zeros((jax.device_count(), 256))),))
+
+
+def test_baseline_entry_carries_comm_vector():
+    entry, errs = baseline.snapshot_entrypoint(_psum_ep(), compiled=False)
+    assert not errs
+    n = jax.device_count()
+    assert entry["cost"]["comm_bytes"] == 1024 * n
+    assert entry["comm"] == {"psum": 1024 * n}
+
+
+def test_planted_comm_regression_trips_dp301_naming_collective():
+    """The acceptance planted regression: halve the BASELINE's psum bytes
+    (equivalently, the live program doubled its collective traffic) — the
+    existing DP301 gate must fire on the comm_bytes metric and name psum
+    as the dominant regressing collective."""
+    ep = _psum_ep()
+    data, errs = baseline.build_baseline([ep], compiled=False)
+    assert not errs
+    doctored = json.loads(json.dumps(data))
+    entry = doctored["entries"]["fx.comm_reg"]
+    entry["cost"]["comm_bytes"] = entry["cost"]["comm_bytes"] / 2
+    entry["comm"]["psum"] = entry["comm"]["psum"] / 2
+    findings = baseline.check_entrypoints([ep], doctored, compiled=False)
+    f301 = [f for f in findings if f.rule_id == "DP301"]
+    assert f301, [f.render() for f in findings]
+    assert "comm bytes" in f301[0].message
+    assert "psum" in f301[0].message
+
+
+def test_shipped_baselines_carry_comm_vectors():
+    """Every shipped entry has the comm_bytes cost; the one production
+    collective (the sharded masked-fill gradient's mask-axis psum) is
+    priced nonzero; the meshed kernel-tier programs price to ZERO — that
+    zero IS the recorded shard-locality claim."""
+    data = baseline.load_baseline()
+    assert data is not None
+    entries = data["entries"]
+    missing = [n for n, e in entries.items()
+               if "comm_bytes" not in e.get("cost", {})]
+    assert not missing, missing
+    grad = entries["ops.masked_fill.sharded_grad"]
+    assert grad["cost"]["comm_bytes"] > 0
+    assert set(grad["comm"]) == {"psum"}
+    for kname in ("stem", "token"):
+        e = entries[f"ops.kernel_tier.{kname}.phase1.kernel.mesh"]
+        assert e["cost"]["comm_bytes"] == 0
+        assert e["comm"] == {}
+
+
+# ---------- --baseline update topology refusal ----------
+
+def test_baseline_update_refuses_unenumerable_mesh_entries(
+        tmp_path, monkeypatch, capsys):
+    """A baseline holding .mesh entries must NOT be regenerable on a
+    single-device host: update would silently drop the mesh program bank
+    (and its comm vectors) and turn the gate vacuous. Usage error, file
+    untouched, actionable message."""
+    original = json.dumps({"entries": {
+        "ops.kernel_tier.stem.phase1.kernel.mesh": {
+            "fingerprint": "x", "cost": {"comm_bytes": 0}}}})
+    path = tmp_path / "baselines.json"
+    path.write_text(original, encoding="utf-8")
+    monkeypatch.setattr(jax, "device_count", lambda: 1)
+    rc = cli_main(["--baseline", "update", "--baseline-file", str(path),
+                   "--entrypoints", "baseline_programs:clean_entrypoints"])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert path.read_text(encoding="utf-8") == original
+    assert "NOT written" in err
+    assert "xla_force_host_platform_device_count" in err
+    assert "ops.kernel_tier.stem.phase1.kernel.mesh" in err
+
+
+# ---------- the shipped tree stays clean ----------
+
+@pytest.mark.slow
+def test_shipped_tree_comms_clean():
+    """Covered in CI by the run_tests.sh `--comms` gate (same audit over
+    the same registry); marked slow like its trace/baseline siblings."""
+    findings = comms.audit_production()
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+# ---------- CLI ----------
+
+def test_cli_comms_exit_codes(capsys):
+    rc = cli_main(["--comms", "--entrypoints",
+                   "comms_programs:clean_entrypoints"])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().err
+    rc = cli_main(["--comms", "--entrypoints",
+                   "comms_programs:bad_entrypoints", "--format", "json"])
+    assert rc == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    rules = {json.loads(line)["rule"] for line in out if line}
+    assert rules == {"DP600", "DP601", "DP602", "DP603"}
+
+
+def test_cli_comms_select(capsys):
+    rc = cli_main(["--comms", "--select", "DP601", "--entrypoints",
+                   "comms_programs:bad_entrypoints"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "DP601" in out and "DP600" not in out
+    assert cli_main(["--comms", "--select", "DP600,DP601,DP602,DP603",
+                     "--entrypoints",
+                     "comms_programs:clean_entrypoints"]) == 0
+    # cross-wing IDs stay a loud usage error, not a vacuous pass
+    assert cli_main(["--comms", "--select", "DP301", "--entrypoints",
+                     "comms_programs:clean_entrypoints"]) == 2
+
+
+def test_cli_sarif_output(capsys):
+    rc = cli_main(["--comms", "--entrypoints",
+                   "comms_programs:bad_entrypoints", "--format", "sarif"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    rules = run["tool"]["driver"]["rules"]
+    assert {r["id"] for r in rules} == {"DP600", "DP601", "DP602", "DP603"}
+    assert run["results"], "positive fixtures must produce results"
+    for res in run["results"]:
+        assert rules[res["ruleIndex"]]["id"] == res["ruleId"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["region"]["startLine"] >= 1
+        assert res["level"] == "error"
+
+
+def test_cli_sarif_clean_is_empty_results(capsys):
+    rc = cli_main(["--comms", "--entrypoints",
+                   "comms_programs:clean_entrypoints", "--format", "sarif"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_sarif_serves_other_modes_too(capsys, tmp_path):
+    """One shared serializer: the lint wing emits the same SARIF shape."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\n\ndef f(x):\n    return jax.jit(x)\n",
+                   encoding="utf-8")
+    rc = cli_main([str(tmp_path), "--format", "sarif"])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert doc["version"] == "2.1.0"
+    assert rc in (0, 1)

@@ -173,6 +173,66 @@ def test_mixer_engine_matches_frozen_clean_reference():
             assert abs(float(mr[wrow, j]) - ref_m[wrow, n]) < 1e-4
 
 
+# ------------------------------------------------- shard_map wrappers
+# The meshed kernel tier (the programs the DP603 shard-local audit
+# certifies): each kernel under its shard_map wrapper over the data axis
+# of the tests' 8-device (4, 2) virtual mesh must reproduce its
+# single-chip contract EXACTLY — the wrappers shard the batch, they must
+# not perturb a single bit beyond each kernel's existing tolerance.
+
+
+def _mesh42():
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()).reshape(-1, 2), ("data", "mask"))
+
+
+@pytest.mark.skipif(jax.device_count() % 2 or jax.device_count() < 2,
+                    reason="needs an even multi-device mesh")
+def test_stem_kernel_mesh_wrapper_bit_exact():
+    from dorpatch_tpu.ops.stem_fold import fold_masked_stem_sharded
+
+    mesh = _mesh42()
+    b = int(dict(mesh.shape)["data"])
+    k, s, pad = 3, 1, ((1, 1), (1, 1))
+    plan = plan_windows(_rect_table(), IMG, k, s, pad)
+    h = (IMG + 2 - k) // s + 1
+    kern = jax.random.normal(jax.random.PRNGKey(0), (k, k, 3, 8))
+    clean = jax.random.normal(jax.random.PRNGKey(1), (b, h, h, 8))
+    u = jax.random.normal(jax.random.PRNGKey(2), (b, IMG, IMG, 3))
+    ref = fold_masked_stem(kern, clean, u, plan, (s, s), pad)
+    got = fold_masked_stem_sharded(kern, clean, u, plan, (s, s), pad,
+                                   mesh, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+@pytest.mark.skipif(jax.device_count() % 2 or jax.device_count() < 2,
+                    reason="needs an even multi-device mesh")
+def test_attention_kernel_mesh_wrapper_matches_reference():
+    from dorpatch_tpu.ops.masked_kv_attn import masked_kv_attention_sharded
+
+    mesh = _mesh42()
+    b = int(dict(mesh.shape)["data"])
+    c, s, h, f, t = 3, 4, 2, 8, 9
+    ks = jax.random.split(jax.random.PRNGKey(3), 7)
+    q = jax.random.normal(ks[0], (b, c, s, h, f))
+    kd = jax.random.normal(ks[1], (b, c, s, h, f))
+    vd = jax.random.normal(ks[2], (b, c, s, h, f))
+    kc = jax.random.normal(ks[3], (b, t, h, f))
+    vc = jax.random.normal(ks[4], (b, t, h, f))
+    clean_bias = jnp.where(jax.random.bernoulli(ks[5], 0.2, (b, c, t)),
+                           -1e9, 0.0)
+    dirty_bias = jnp.where(jax.random.bernoulli(ks[6], 0.25, (b, c, s)),
+                           -1e9, 0.0)
+    dirty_bias = dirty_bias.at[:, :, 0].set(0.0)
+    ref = masked_kv_attention_reference(q, kd, vd, kc, vc,
+                                        clean_bias, dirty_bias)
+    got = masked_kv_attention_sharded(q, kd, vd, kc, vc, clean_bias,
+                                      dirty_bias, mesh, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+
+
 def test_mixer_engine_registry_resolution():
     """Both ResMLP names resolve the mixer engine; non-grid-aligned input
     resolves none (no token geometry)."""

@@ -80,6 +80,10 @@ def test_report_flags_open_spans_as_hang(tmp_path, capsys):
     assert "OPEN" in out and "run/batch/artifact_io" in out
 
 
+# ~155 s on the single CI core: the full attack+certify pipeline with
+# live telemetry. The report CLI smoke in run_tests.sh plus the
+# fixture-driven tests above keep the content covered in tier-1.
+@pytest.mark.slow
 def test_telemetry_e2e_single_process_cpu(tmp_path, capsys):
     """ISSUE acceptance: a single-process CPU run (synthetic data, small
     victim) produces run.json, events.jsonl with nested spans covering >=95%

@@ -678,6 +678,9 @@ def test_exhausted_restarts_retire_degrade_not_hang(tmp_path):
 
 # ---------- incremental certify on the serve hot path ----------
 
+# ~85 s on the single CI core; the serve loadgen smoke in run_tests.sh
+# gates the same zero-recompile hot path on every CI run.
+@pytest.mark.slow
 def test_serve_incremental_zero_recompile_e2e(tmp_path):
     """Acceptance: with the token incremental engine enabled, warmup
     compiles the engine-backed programs once per shape bucket and mixed
