@@ -191,6 +191,8 @@ def child_jax() -> None:
     dtype = os.environ.get("BENCH_DTYPE")
     if dtype is None:
         dtype = "float32" if jax.default_backend() == "cpu" else "bfloat16"
+    # short aliases (the certify precision surface): f32|bf16|ab
+    dtype = {"f32": "float32", "bf16": "bfloat16"}.get(dtype, dtype)
 
     log(f"jax devices: {jax.devices()} dtype: {dtype}")
 
@@ -274,6 +276,9 @@ def child_jax() -> None:
             "ips": batch / step_seconds,
             "batch": batch,
             "backend": jax.default_backend(),
+            # the EOT fwd+bwd precision this row measured (short form; the
+            # certify child stamps its DefenseConfig.compute_dtype here)
+            "compute_dtype": "bf16" if dtype == "bfloat16" else "f32",
             "remat": remat,
             "mfu": s.get("mfu"),
             "step_seconds": round(step_seconds, 4),
@@ -332,8 +337,27 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
     per-image cost in full-forward units (36.0 un-pruned; every certified
     image pays this floor). `forward_equivalents_total_per_image` is the
     whole certify's fractional cost, and MFU credits fractional forwards.
-    Incremental engines run the f32 params path (bf16 requests fall back,
-    logged).
+
+    BENCH_DTYPE selects the certify bank precision
+    (DefenseConfig.compute_dtype): "f32"/"float32", "bf16"/"bfloat16"
+    (engine families included — the bank casts params once and escalates
+    small-margin images through the f32 exhaustive program), or "ab",
+    which times BOTH banks on the same batch, asserts
+    verdict-parity-or-margin-flagged, and reports `dtype_speedup` plus
+    `dtype_bytes_ratio` (predicted phase-1 HBM bytes, bf16/f32 — strictly
+    < 1 by the baseline cost model's itemsize pricing). Every certify row
+    stamps the precision it ran as `compute_dtype`.
+
+    BENCH_STREAM ("1" default at BENCH_IMG >= 128) sources the bench
+    batch through `data.streaming_batches` — the chunked background
+    loader + double-buffered device prefetcher the pipeline's 224-input
+    eval loop rides — instead of on-device RNG (BENCH_SOURCE picks the
+    stream: "synthetic" default, "procedural" for the learnable task).
+    BENCH_EVENTS=<dir> writes the run's events.jsonl there — the streaming
+    loader's prefetch/wait telemetry included, so `observe.report` renders
+    overlap for a bench-only run. The timed reps always execute under the
+    ARMED recompile watchdog with trace counts frozen after warmup: a
+    retrace fails the row instead of silently timing compilation.
 
     BENCH_KERNEL gates the Pallas kernel tier (DefenseConfig.use_pallas;
     "on" default = the production "auto" gate): "off" pins the XLA tier,
@@ -386,24 +410,22 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
     victim = get_model(dataset, arch, img_size=img,
                        gn_impl=os.environ.get("BENCH_GN") or "auto")
     apply_fn = victim.apply
-    if dtype == "bfloat16" and incr != "off":
-        log("BENCH_INCR: incremental engines run the f32 params path; "
-            "timing f32 for every mode")
-        dtype = "float32"
-    if dtype == "bfloat16":
-        params16 = jax.tree_util.tree_map(
-            lambda a: a.astype(jnp.bfloat16)
-            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
-            victim.params)
+    # certify precision is a first-class defense axis
+    # (DefenseConfig.compute_dtype): the certifier builds its own bf16
+    # program bank — engine families included — under the margin-escalation
+    # contract, so no apply_fn wrapper and no f32 fallback for BENCH_INCR.
+    # BENCH_DTYPE=ab times BOTH banks on the same batch (below).
+    if dtype not in ("float32", "bfloat16", "ab"):
+        raise AssertionError(
+            f"BENCH_DTYPE={dtype!r} (certify mode takes f32|bf16|ab)")
+    cdt = "bfloat16" if dtype == "bfloat16" else "float32"
 
-        def apply_fn(_p, xx):  # noqa: F811 - certify runs bf16 like the attack
-            return victim.apply(params16, xx.astype(jnp.bfloat16)).astype(
-                jnp.float32)
-
-    def make_defense(mode, incremental="off", use_pallas=None):
+    def make_defense(mode, incremental="off", use_pallas=None,
+                     compute_dtype=None):
         cfg = DefenseConfig(ratios=(0.06,), chunk_size=128, prune=mode,
                             incremental=incremental,
-                            use_pallas=use_pallas or kern_gate)
+                            use_pallas=use_pallas or kern_gate,
+                            compute_dtype=compute_dtype or cdt)
         engine = victim.incremental if incremental != "off" else None
         if mesh is not None:
             return parallel.make_sharded_defenses(
@@ -411,7 +433,36 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
         return build_defenses(apply_fn, img, cfg, incremental=engine)[0]
 
     key = jax.random.PRNGKey(0)
-    x = jax.random.uniform(key, (batch, img, img, 3))
+    from dorpatch_tpu import observe
+
+    elog = None
+    ev_dir = os.environ.get("BENCH_EVENTS")
+    if ev_dir:
+        # drop this run's events.jsonl into BENCH_EVENTS: the streaming
+        # loader's `data.prefetch` spans and `data.stream.wait` events land
+        # there, so `observe.report` shows prefetch overlap for a
+        # bench-only run exactly like a pipeline results dir
+        os.makedirs(ev_dir, exist_ok=True)
+        elog = observe.EventLog(os.path.join(ev_dir, "events.jsonl"),
+                                run_id="bench-certify")
+        elog.__enter__()
+    stream = os.environ.get("BENCH_STREAM",
+                            "1" if img >= 128 else "0") == "1"
+    if stream:
+        # the production-224 input path: the bench batch arrives through
+        # the chunked background loader + double-buffered device
+        # prefetcher (data.streaming_batches) instead of on-device RNG —
+        # the same path the pipeline's eval loop and serve warmup ride
+        src = os.environ.get("BENCH_SOURCE") or "synthetic"
+        batch_iter = data_lib.streaming_batches(
+            dataset, os.environ.get("BENCH_DATA_DIR") or "data/", batch,
+            img_size=img, seed=0, source=src, depth=2, mesh=mesh)
+        x_np, _ = next(batch_iter)
+        batch_iter.close()
+        x = jnp.asarray(x_np)
+        log(f"bench batch streamed ({src}, img={img})")
+    else:
+        x = jax.random.uniform(key, (batch, img, img, 3))
     q = max(4, img // 8)
     # the disagreement inducer, interleaved rather than a contiguous block:
     # single-chip scheduling is order-blind, but the meshed pruned path
@@ -436,8 +487,9 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
 
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
 
-    def time_mode(mode, xx, incremental="off", use_pallas=None):
-        d = make_defense(mode, incremental, use_pallas)
+    def time_mode(mode, xx, incremental="off", use_pallas=None,
+                  compute_dtype=None):
+        d = make_defense(mode, incremental, use_pallas, compute_dtype)
         if mesh is not None:
             # sharded over the data axis when it divides the batch; the
             # eager refresh arithmetic below preserves the placement
@@ -457,19 +509,90 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
                 f"{time.perf_counter() - t0:.2f}s")
         timer = observe.StepTimer()
         recs = None
-        for _ in range(reps):
-            xx = xx * 0.999 + 0.0005
-            timer.start()
-            recs = d.robust_predict(victim.params, xx, victim.num_classes,
-                                    bucket_sizes=buckets)
-            # robust_predict materializes records: a real transfer
-            timer.stop()
+        # the timed reps must not retrace: freeze the programs' trace
+        # counts after warmup and run the reps under the ARMED recompile
+        # watchdog, so a shape leak fails the bench loudly instead of
+        # quietly timing compilation
+        from dorpatch_tpu.analysis.sanitize import Sanitizer
+
+        warm_traces = d.pruned_trace_counts()
+        with Sanitizer(debug_nans=False, log_compiles=False):
+            for _ in range(reps):
+                xx = xx * 0.999 + 0.0005
+                timer.start()
+                recs = d.robust_predict(victim.params, xx,
+                                        victim.num_classes,
+                                        bucket_sizes=buckets)
+                # robust_predict materializes records: a real transfer
+                timer.stop()
+        if d.pruned_trace_counts() != warm_traces:
+            raise AssertionError(
+                f"[{mode}/incr={incremental}] recompiled during timed "
+                f"reps: {warm_traces} -> {d.pruned_trace_counts()}")
         return d, xx, sum(timer.block_seconds) / reps, recs
 
     prune_stats = {"prune": prune}
     if mesh is not None:
         prune_stats["mesh"] = f"{d_ax}x{m_ax}"
-    if prune == "ab":
+    if dtype == "ab":
+        # precision A/B: the SAME pruned schedule on the SAME batch at
+        # both certify banks, differing only in
+        # DefenseConfig.compute_dtype. The timed headline is the bf16
+        # side (the new bank); dtype_speedup is its win over f32. Parity
+        # is verdict-identical-or-margin-flagged: the bf16 bank already
+        # re-certifies every small-margin image through the f32 exhaustive
+        # program (defense._PrunedPending._escalate), so any REMAINING
+        # mismatch must itself sit below the escalation margin (an argmax
+        # boundary ULP flip between the two padded program shapes) —
+        # a mismatch at a comfortable margin is a bank bug: hard fail.
+        base_prune = "exact" if prune == "off" else prune
+        incr_mode_req = "auto" if incr == "on" else "off"
+        d32, x_final, dt32, recs32 = time_mode(
+            base_prune, x, incremental=incr_mode_req,
+            compute_dtype="float32")
+        d, _, dt, recs = time_mode(
+            base_prune, x, incremental=incr_mode_req,
+            compute_dtype="bfloat16")
+        mism = [i for i, (a, b) in enumerate(zip(recs32, recs))
+                if (a.prediction, a.certification) != (b.prediction,
+                                                       b.certification)]
+        tol = d.config.incremental_margin
+        unflagged = [i for i in mism
+                     if d.last_min_margin is None
+                     or d.last_min_margin[i] >= tol]
+        if unflagged:
+            raise AssertionError(
+                f"bf16 bank flipped {len(unflagged)} verdict(s) at "
+                f"margins >= {tol} — the escalation contract should have "
+                "caught them")
+        prune_stats.update({
+            "incr": d.resolved_incremental(incr_mode_req),
+            "ips_f32": round(batch / dt32, 4),
+            "dtype_speedup": round(dt32 / dt, 3),
+            "parity": not mism,
+            "parity_mismatches": len(mism),
+        })
+        # predicted HBM traffic of the dominant program under each bank
+        # (the baseline cost model prices bytes by aval itemsize, so the
+        # bf16 phase-1 program must come out strictly lighter — the same
+        # invariant tools/certify_bf16_smoke.py gates on the checked-in
+        # baselines). Estimate-only: failure just omits the numbers.
+        try:
+            from dorpatch_tpu.analysis import baseline as baseline_lib
+            from dorpatch_tpu.analysis.entrypoints import _unwrap
+
+            bytes_by = {}
+            for dd, tagd in ((d32, "f32"), (d, "bf16")):
+                jaxpr = jax.make_jaxpr(_unwrap(dd._phase1))(
+                    dd._cast_params(victim.params), x_final)
+                bytes_by[tagd] = baseline_lib.estimate_cost(
+                    jaxpr)["est_bytes"]
+            if bytes_by.get("f32"):
+                prune_stats["dtype_bytes_ratio"] = round(
+                    bytes_by["bf16"] / bytes_by["f32"], 3)
+        except Exception as e:  # noqa: BLE001 - reporting axis only
+            log(f"dtype bytes-ratio estimate unavailable ({e})")
+    elif prune == "ab":
         d, x_final, dt_ex, recs_ex = time_mode("off", x)
         _, _, dt, recs = time_mode("exact", x)
         mismatches = sum(
@@ -693,7 +816,8 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
         chunk = min(d.config.chunk_size, n_masks)
         shaped = jax.ShapeDtypeStruct(
             (chunk, img, img, 3),
-            jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+            # the timed side of BENCH_DTYPE=ab is the bf16 bank
+            jnp.bfloat16 if dtype in ("bfloat16", "ab") else jnp.float32)
         compiled = jax.jit(victim.apply).lower(victim.params, shaped).compile()
         analysis = compiled.cost_analysis()
         if isinstance(analysis, list):
@@ -711,12 +835,19 @@ def _certify_bench(dataset, arch, img, batch, dtype, reps) -> None:
         "ips": batch / dt,
         "batch": batch,
         "backend": jax.default_backend(),
+        # certify sweep precision of the timed row (short form): the
+        # DefenseConfig.compute_dtype bank that produced these numbers,
+        # "ab" when both banks ran (dtype_speedup carries the ratio)
+        "compute_dtype": {"float32": "f32", "bfloat16": "bf16",
+                          "ab": "ab"}[dtype],
         "masks_per_image": int(n_masks),
         "masked_fwd_per_sec": round(executed / dt, 1),
         "seconds_per_batch": round(dt, 4),
         "mfu": mfu,
         **prune_stats,
     }))
+    if elog is not None:
+        elog.__exit__(None, None, None)
 
 
 # ------------------------------------------------------------ orchestrator
@@ -1120,6 +1251,24 @@ def main() -> None:
                                    "tier axis alone; set BENCH_INCR=off "
                                    "and drop BENCH_PRUNE=ab"}))
         return
+    bd = {"f32": "float32", "bf16": "bfloat16"}.get(
+        os.environ.get("BENCH_DTYPE") or "", os.environ.get("BENCH_DTYPE"))
+    if bd == "ab":
+        if mode != "certify":
+            print(json.dumps({"metric": err_metric, "value": 0.0,
+                              "unit": "images/sec", "vs_baseline": 0.0,
+                              "error": "BENCH_DTYPE=ab only applies to "
+                                       "BENCH_MODE=certify"}))
+            return
+        if bp == "ab" or bi == "ab" or bk == "ab":
+            # one A/B axis per row: the precision A/B fixes schedule,
+            # engine and kernel gate, varying only compute_dtype
+            print(json.dumps({"metric": err_metric, "value": 0.0,
+                              "unit": "images/sec", "vs_baseline": 0.0,
+                              "error": "BENCH_DTYPE=ab measures the "
+                                       "precision axis alone; drop the "
+                                       "other =ab knobs"}))
+            return
     bm = os.environ.get("BENCH_MESH") or ""
     if bm:
         parts = bm.split("x")
@@ -1245,15 +1394,20 @@ def main() -> None:
         out["mfu"] = res["mfu"]
     for k in ("remat", "step_seconds", "fwd_gflops_per_image", "batch",
               "masked_images_per_sec", "masks_per_image", "masked_fwd_per_sec",
-              "seconds_per_batch", "backend", "prune", "forwards_per_image",
+              "seconds_per_batch", "backend", "compute_dtype", "prune",
+              "forwards_per_image",
               "prune_rate", "ips_exhaustive", "prune_speedup", "parity",
               "parity_mismatches", "incr", "incr_speedup", "ips_pruned_only",
+              "ips_f32", "dtype_speedup", "dtype_bytes_ratio",
               "forward_equivalents_per_image",
               "forward_equivalents_total_per_image", "mesh",
               "kernel", "kernel_speedup", "kernel_roofline",
               "comm_bytes", "comm_by_collective"):
         if res.get(k) is not None:
             out[k] = res[k]
+    # every BENCH row names its compute precision next to program_set;
+    # rows from children predating the stamp ran f32
+    out.setdefault("compute_dtype", "f32")
     if fallback is not None:
         # A fallback row is a liveness proof, not a framework measurement:
         # jax-CPU f32 on the small victim vs torch-CPU on the same config.

@@ -793,6 +793,32 @@ def check_summary(findings: List[Finding], entries: int,
                                      float(fl) / max(float(by), 1.0))),
         })
     intensity.sort(key=lambda r: (-r["est_bytes"], r["name"]))
+    # mixed-precision rollup: every `.bf16`-tagged entry against its f32
+    # twin (same name minus the tag); the report renders the aggregate
+    # predicted-HBM ratio so the bf16 bank's bandwidth win — the invariant
+    # the certify smoke gate enforces per entry — is visible at a glance
+    bf16_bytes = f32_bytes = 0.0
+    paired = 0
+    all_entries = data.get("entries", {})
+    for name, e in all_entries.items():
+        if ".bf16" not in name:
+            continue
+        twin = all_entries.get(name.replace(".bf16", ""))
+        if twin is None:
+            continue
+        by = (e.get("cost", {}) or {}).get("est_bytes")
+        twin_by = (twin.get("cost", {}) or {}).get("est_bytes")
+        if by is None or twin_by is None:
+            continue
+        paired += 1
+        bf16_bytes += float(by)
+        f32_bytes += float(twin_by)
+    dtype_bytes = None
+    if paired:
+        dtype_bytes = {"paired_entries": paired,
+                       "bf16_bytes": bf16_bytes, "f32_bytes": f32_bytes,
+                       "ratio": round(bf16_bytes / f32_bytes, 4)
+                       if f32_bytes else None}
     return {
         "entries": entries,
         "baseline_file": str(path),
@@ -804,4 +830,5 @@ def check_summary(findings: List[Finding], entries: int,
             {"rule": f.rule_id, "path": f.path, "line": f.line,
              "message": f.message} for f in findings],
         "intensity": intensity[:8],
+        "dtype_bytes": dtype_bytes,
     }

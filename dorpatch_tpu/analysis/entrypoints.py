@@ -307,6 +307,104 @@ def _enumerate_defense(apply_fn, params) -> None:
         register_bucket_ladder(d._rows._name, d.row_bucket_sizes)
 
 
+def _bf16_params_abs(params):
+    """Abstract bf16-cast weight tree: the avals `PatchCleanser._cast_params`
+    hands the bf16 bank's programs (floating leaves -> bfloat16, everything
+    else passes through)."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(s):
+        if jnp.issubdtype(s.dtype, jnp.floating):
+            return jax.ShapeDtypeStruct(tuple(s.shape), jnp.bfloat16)
+        return s
+
+    return jax.tree_util.tree_map(leaf, abstractify(params))
+
+
+def _enumerate_bf16_defense(apply_fn, params) -> None:
+    """The bf16 certify bank (`DefenseConfig.compute_dtype="bfloat16"`):
+    `.bf16`-tagged twins of the per-radius phase1/pairs/rows programs, fed
+    the bf16-cast weight avals production's `_cast_params` produces. Images
+    stay f32 — the cast happens inside the traced program, so jit cache
+    keys never fork on input dtype. `d._predict` is NOT re-registered:
+    under bf16 it IS the f32 escalation oracle, the identical program and
+    wrapper name the f32 bank already covers. DP301 prices this bank as a
+    distinct program set next to the untagged twins; the smoke gate
+    (`tools/certify_bf16_smoke.py`) asserts strictly fewer bytes entry by
+    entry."""
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu.config import DefenseConfig
+    from dorpatch_tpu.defense import build_defenses
+
+    cfg = DefenseConfig(chunk_size=64, compute_dtype="bfloat16")
+    imgs = jax.ShapeDtypeStruct(
+        (AUDIT_BATCH, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    cast_abs = _bf16_params_abs(params)
+    for d in build_defenses(apply_fn, AUDIT_IMG_SIZE, cfg,
+                            recompile_budget=1):
+        register_entrypoint(d._phase1, (cast_abs, imgs))
+        register_entrypoint(d._pairs, (cast_abs, imgs))
+        w = int(d.row_bucket_sizes[0])
+        imgs_g = jax.ShapeDtypeStruct(
+            (w, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+        mask_idx = jax.ShapeDtypeStruct((w,), jnp.int32)
+        register_entrypoint(d._rows, (cast_abs, imgs_g, mask_idx))
+        register_bucket_ladder(d._rows._name, d.row_bucket_sizes)
+
+
+def _enumerate_bf16_incremental() -> None:
+    """The incremental engines' bf16 banks: the token/stem/mixer certify
+    programs with `compute_dtype="bfloat16"` (engine tables and weights
+    cast at family build, images at the program boundary) — one bank per
+    engine family at the shared representative radius, mirroring
+    `_enumerate_incremental` so every `defense.*.bf16.*` incremental entry
+    has an untagged f32 twin in the baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu.config import DefenseConfig
+    from dorpatch_tpu.defense import build_defenses
+    from dorpatch_tpu.models import registry
+
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    dummy = jax.ShapeDtypeStruct(
+        (1, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    imgs = jax.ShapeDtypeStruct(
+        (AUDIT_BATCH, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+    for arch in ("cifar_vit", "cifar_resnet18", "cifar_resmlp"):
+        model = registry.build_bare_model(arch, AUDIT_CLASSES)
+        engine = registry.incremental_engine(arch, model, AUDIT_IMG_SIZE)
+
+        def apply(params, images01, _m=model):
+            return _m.apply(params, (images01 - 0.5) / 0.5)
+
+        cast_abs = _bf16_params_abs(jax.eval_shape(model.init, key, dummy))
+        d = build_defenses(apply, AUDIT_IMG_SIZE,
+                           DefenseConfig(ratios=(0.06,), chunk_size=64,
+                                         compute_dtype="bfloat16"),
+                           recompile_budget=1, incremental=engine)[0]
+        w = int(d.row_bucket_sizes[0])
+        imgs_g = jax.ShapeDtypeStruct(
+            (w, AUDIT_IMG_SIZE, AUDIT_IMG_SIZE, 3), jnp.float32)
+        register_bucket_ladder(d._rows._name, d.row_bucket_sizes)
+        if d._rows_incr is not None:
+            register_bucket_ladder(d._rows_incr._name, d.row_bucket_sizes)
+        for name, fn, kind in d.pruned_programs():
+            if kind == "imgs":
+                register_entrypoint(fn, (cast_abs, imgs), name=name)
+            elif kind == "rows_sets":
+                sets = jax.ShapeDtypeStruct((w, d.num_first), jnp.int32)
+                register_entrypoint(fn, (cast_abs, imgs_g, sets),
+                                    name=name)
+            else:
+                mask_idx = jax.ShapeDtypeStruct((w,), jnp.int32)
+                register_entrypoint(fn, (cast_abs, imgs_g, mask_idx),
+                                    name=name)
+
+
 def _enumerate_incremental() -> None:
     """The mask-aware incremental certify programs (DefenseConfig.
     incremental): one bank per engine family — the token-pruned ViT
@@ -562,7 +660,9 @@ def production_entrypoints(clear: bool = True) -> List[EntryPoint]:
     with capture_entrypoints():
         _enumerate_attack(apply_fn, params)
         _enumerate_defense(apply_fn, params)
+        _enumerate_bf16_defense(apply_fn, params)
         _enumerate_incremental()
+        _enumerate_bf16_incremental()
         _enumerate_train()
         _enumerate_model_init()
         _enumerate_serve(apply_fn, params)

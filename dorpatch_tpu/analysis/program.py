@@ -33,6 +33,18 @@ runtime watchdog could previously only catch after paying a real compile:
 - **DP206 donation** — an argument declared donated whose buffer no
   output can reuse (no shape/dtype match): the donation silently buys
   nothing and XLA warns at compile time on device.
+- **DP208 bf16-silent-upcast** — inside a declared-bf16 program (name
+  carries the `.bf16` tag), large float32 compute fed by a bf16->f32
+  upcast: dtype promotion (which the jnp layer materializes as an
+  inserted `convert_element_type`) has silently pulled part of the bank
+  back to f32, doubling that slab's HBM traffic and eroding exactly the
+  bytes win the bank exists for — the defect flax's `nn.GroupNorm`
+  planted in the conv bank. Exempt: f32 accumulations that reduce
+  straight back down (the `E[x^2]` stats idiom,
+  `fused_gn.gn_preserve_dtype`), dot/conv equations declaring
+  `preferred_element_type=float32` (`ops/stem_fold._delta_conv`), and
+  readout-scale outputs (the f32 logit/margin tables,
+  `utils.preds_margins`).
 
 Findings flow through the existing engine types (`engine.Finding`, stable
 IDs, `# noqa:` suppression against the entry point's defining source
@@ -483,6 +495,94 @@ class DonationRule(TraceRule):
                     ctx, f"donated argument {_aval_str(aval)} matches no "
                     "output buffer — the donation frees nothing; drop it "
                     "or return an updated value of the same shape/dtype")
+
+
+# ---------------------------------------------------------------- DP208
+
+def _n_elems(a) -> int:
+    n = 1
+    for d in a.shape:
+        try:
+            n *= int(d)
+        except (TypeError, ValueError):
+            return 1 << 30  # dynamic dim: assume big
+    return n
+
+
+@register_trace
+class SilentUpcastRule(TraceRule):
+    id = "DP208"
+    name = "bf16-silent-upcast"
+    description = ("large float32 compute fed by a bfloat16->float32 "
+                   "upcast inside a declared-bf16 program — promotion has "
+                   "silently pulled part of the bank back to f32 (f32 "
+                   "accumulations that reduce straight back down, declared "
+                   "preferred_element_type, and readout-scale outputs are "
+                   "exempt)")
+
+    #: consuming an f32 upcast here is the accumulate idiom (means/stats),
+    #: not a leak — the big f32 tensor collapses immediately
+    _REDUCERS = frozenset({
+        "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+        "reduce_and", "reduce_or", "argmax", "argmin"})
+    #: f32 outputs at or below this element count are readout-scale
+    #: (margins, label tables, per-group stats), never the bank's slabs
+    _SMALL_ELEMS = 8192
+
+    def check(self, ctx: ProgramContext) -> Iterator[Finding]:
+        if ".bf16" not in ctx.name:
+            return
+        reported = 0
+        for j in iter_jaxprs(ctx.jaxpr):
+            raw = _raw(j)
+            consumers: Dict[int, List[Any]] = {}
+            for eqn in raw.eqns:
+                for v in eqn.invars:
+                    if _is_aval(getattr(v, "aval", None)):
+                        consumers.setdefault(id(v), []).append(eqn)
+            # every bf16 -> f32 convert result: the promotion frontier
+            upcast: Set[int] = set()
+            for eqn in raw.eqns:
+                if eqn.primitive.name != "convert_element_type":
+                    continue
+                src = getattr(eqn.invars[0], "aval", None)
+                dst = eqn.outvars[0].aval
+                if _is_aval(src) and str(src.dtype) == "bfloat16" \
+                        and str(dst.dtype) == "float32":
+                    upcast.add(id(eqn.outvars[0]))
+            if not upcast:
+                continue
+            for eqn in raw.eqns:
+                prim = eqn.primitive.name
+                if prim == "convert_element_type" or prim in self._REDUCERS \
+                        or _eqn_subjaxprs(eqn):
+                    continue
+                pet = eqn.params.get("preferred_element_type")
+                if pet is not None and str(pet) == "float32":
+                    continue  # declared f32 accumulation, explicit in source
+                if not any(id(v) in upcast for v in eqn.invars):
+                    continue
+                big = [v.aval for v in eqn.outvars
+                       if _is_aval(getattr(v, "aval", None))
+                       and str(v.aval.dtype) == "float32"
+                       and _n_elems(v.aval) > self._SMALL_ELEMS]
+                if not big:
+                    continue
+                # the E[x^2] idiom: a large f32 product is fine when every
+                # consumer reduces it straight back down
+                cons = [c for v in eqn.outvars
+                        for c in consumers.get(id(v), [])]
+                if cons and all(c.primitive.name in self._REDUCERS
+                                for c in cons):
+                    continue
+                yield self.finding(
+                    ctx, f"equation `{prim}` turns a bf16->f32 upcast into "
+                    f"a {_aval_str(big[0])} intermediate inside a bf16 bank "
+                    "— promotion is silently running this math at f32; "
+                    "keep the slab at bfloat16 or reduce it immediately")
+                reported += 1
+                if reported >= 3:  # one program, one story: cap the noise
+                    return
 
 
 # ---------------------------------------------------------------- driver

@@ -144,6 +144,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "tolerance-contracted verdicts with no "
                         "escalation; 'off' = full masked forwards for "
                         "every scheduled entry")
+    p.add_argument("--certify-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="certification sweep precision (the defense's "
+                        "compute_dtype): 'bfloat16' runs the masked "
+                        "forwards — phase-1 tables, pair audits, rows, "
+                        "and the incremental engines — in bf16 with f32 "
+                        "logit/margin readouts; images whose evaluated "
+                        "entries come within --incremental-margin of the "
+                        "argmax boundary re-certify through the f32 "
+                        "exhaustive program, so verdicts never weaken "
+                        "(the token-exact escalation law)")
     p.add_argument("--incremental-margin", type=float, default=0.5,
                    help="token/mixer-exact escalation threshold: top-2 "
                         "logit gap "
@@ -335,7 +346,8 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
                               n_patch=args.defense_n_patch,
                               prune=args.prune,
                               incremental=args.incremental,
-                              incremental_margin=args.incremental_margin),
+                              incremental_margin=args.incremental_margin,
+                              compute_dtype=args.certify_dtype),
         serve=ServeConfig(port=args.serve_port,
                           max_batch=args.serve_max_batch,
                           max_queue_depth=args.serve_queue_depth,

@@ -181,6 +181,21 @@ class DefenseConfig:
                                     # far below this; raise it toward inf
                                     # to force-escalate everything (the
                                     # parity-test configuration).
+    compute_dtype: str = "float32"  # certify sweep precision:
+                                    # float32|bfloat16. "bfloat16" builds
+                                    # the bf16 program bank
+                                    # (defense.*.bf16.*): params cast once
+                                    # at family build, images cast at the
+                                    # program boundary, preds/margins read
+                                    # out in f32. Correctness rides the
+                                    # margin-escalation contract — every
+                                    # evaluated entry's top-2 margin is
+                                    # tracked and any image within
+                                    # incremental_margin of the argmax
+                                    # boundary re-certifies through the
+                                    # f32 exhaustive program (the same law
+                                    # as "token-exact"), so bf16 never
+                                    # weakens a verdict.
 
 
 @dataclasses.dataclass(frozen=True)
@@ -417,6 +432,12 @@ class ExperimentConfig:
                                     # flagship's eval stream; "auto" maps
                                     # synthetic_data to synthetic/disk
     img_size: int = 224
+    stream_depth: int = 2           # eval input streaming: background host
+                                    # loader + double-buffered host->device
+                                    # prefetch, this many batches ahead
+                                    # (data.streaming_batches — the
+                                    # production-224 input path). 0 =
+                                    # synchronous in-loop loads.
     gn_impl: str = "auto"           # GroupNorm+ReLU impl for ResNetV2 victims
                                     # (models.resnetv2.GroupNormRelu): auto =
                                     # fused Pallas kernel on single-chip TPU,

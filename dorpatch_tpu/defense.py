@@ -98,6 +98,24 @@ forward count, verdicts and per-image `forwards` stay bit-identical to
 the single-chip pruned oracle. The incremental engines ride the same
 shard-local schedule unchanged (their programs are pure jnp; GSPMD
 propagates the data sharding through them).
+
+bf16 certify bank (`DefenseConfig.compute_dtype="bfloat16"`, CLI
+`--certify-dtype`): the pruned-path programs — phase1/pairs/rows and the
+engine twins — sweep in bfloat16 (the forward-dominated certify path is
+bandwidth-bound, so halved byte traffic is the win). The dtype contract:
+params are cast once per weight tree (`PatchCleanser._cast_params`),
+images are cast at the program boundary INSIDE the traced programs
+(callers keep handing f32 batches, so jit cache keys, entrypoint
+registrations and warmup placements never fork on dtype), and
+preds/margins are read out in f32 (`utils.preds_margins`). Correctness
+rides the margin-escalation law, generalized from "token-exact" to every
+bf16 bank: all programs return top-2 logit margins, and any image whose
+evaluated entries come within `incremental_margin` of the argmax boundary
+re-certifies through the f32 exhaustive program — rounding can only flip
+a label where the margin is small, and small-margin images are exactly
+the ones escalated, so bf16 never weakens a verdict. Program names gain a
+`.bf16` tag (`defense.phase1.bf16.r*`, composing with `.mesh`) so the
+baseline tier prices both banks as distinct program sets.
 """
 
 from __future__ import annotations
@@ -113,6 +131,7 @@ from dorpatch_tpu import data as data_lib
 from dorpatch_tpu import masks as masks_lib
 from dorpatch_tpu import observe
 from dorpatch_tpu import ops
+from dorpatch_tpu import utils
 from dorpatch_tpu.config import DefenseConfig
 
 
@@ -188,6 +207,8 @@ def masked_predictions(
     fill: float = 0.5,
     use_pallas: str = "auto",
     mesh=None,
+    compute_dtype: str = "float32",
+    with_margins: bool = False,
 ) -> jax.Array:
     """Predictions under every mask in `rects`: `[B,H,W,C] x [N,K,4] -> [B,N]`.
 
@@ -208,8 +229,19 @@ def masked_predictions(
     (`ops.masked_fill._mesh_divides`); if chunk_size is smaller than the
     mask axis, the unquantized split is kept (the fill falls back to the
     partitionable XLA path rather than exceeding the memory bound).
+
+    `compute_dtype` ("float32" | "bfloat16") is the sweep precision:
+    images are cast at the program boundary (here, inside the traced
+    program — callers keep handing f32 batches so jit cache keys and
+    warmup placements never fork on dtype) and the masked forwards run in
+    that dtype end to end; `with_margins=True` additionally returns the
+    top-2 logit margins `[B, N]`, read out in f32
+    (`utils.preds_margins`) — the bf16 banks' escalation signal.
     """
     n = rects.shape[0]
+    cdt = jnp.dtype(compute_dtype)
+    if imgs.dtype != cdt:
+        imgs = imgs.astype(cdt)
     m = 1
     if mesh is not None and getattr(mesh, "devices", None) is not None \
             and mesh.devices.size > 1:
@@ -225,10 +257,18 @@ def masked_predictions(
     def body(carry, chunk_rects):
         xm = ops.masked_fill(imgs, chunk_rects, fill, use_pallas, mesh=mesh)
         logits = apply_fn(params, xm.reshape((-1,) + imgs.shape[1:]))
+        if with_margins:
+            preds, margins = utils.preds_margins(logits)
+            return carry, (preds.reshape(batch, chunk_size),
+                           margins.reshape(batch, chunk_size))
         return carry, jnp.argmax(logits, axis=-1).reshape(batch, chunk_size)
 
-    _, preds = jax.lax.scan(body, None, rects_p)
-    return jnp.moveaxis(preds, 0, 1).reshape(batch, -1)[:, :n]
+    _, out = jax.lax.scan(body, None, rects_p)
+
+    def cat(t):
+        return jnp.moveaxis(t, 0, 1).reshape(batch, -1)[:, :n]
+
+    return (cat(out[0]), cat(out[1])) if with_margins else cat(out)
 
 
 def _second_round_index_grid(num_masks: int) -> np.ndarray:
@@ -394,19 +434,25 @@ class _PrunedPending:
                  num_classes: int, bucket_sizes, mode: str,
                  incremental: str = "off"):
         self.pc = pc
-        self.params = params
+        self.params = params       # ORIGINAL tree: escalation runs f32
+        # the bf16 banks dispatch phase 1/2 against the once-cast tree;
+        # `_escalate` keeps the original so the oracle stays f32
+        self.cparams = pc._cast_params(params)
         self.imgs = imgs           # device, possibly bucket-padded
         self.n = n                 # real (unpadded) image count
         self.num_classes = num_classes
         self.bucket_sizes = bucket_sizes
         self.mode = mode
         self.incr = incremental    # resolved incremental mode
-        # phase 1: the incremental programs return (preds, margins); the
+        # phase 1: the incremental programs — and, under bf16, the
+        # standard program too — return (preds, margins); the f32
         # standard program returns the bare [B_pad, M] prediction table
         if incremental != "off":
-            self.t1, self.t1_margins = pc._phase1_incr(params, imgs)
+            self.t1, self.t1_margins = pc._phase1_incr(self.cparams, imgs)
+        elif pc._bf16:
+            self.t1, self.t1_margins = pc._phase1(self.cparams, imgs)
         else:
-            self.t1, self.t1_margins = pc._phase1(params, imgs), None
+            self.t1, self.t1_margins = pc._phase1(self.cparams, imgs), None
         self._scheduled = False
         self.p1 = None
         self.m1 = None             # [n, M] phase-1 margins (incremental)
@@ -469,7 +515,7 @@ class _PrunedPending:
                              axis=0), bucket)
                 mapping = [(pos, int(self.pair_idx[off + pos]))
                            for pos in range(cnt)]
-                self.pair_chunks.append((pairs_prog(self.params, xu),
+                self.pair_chunks.append((pairs_prog(self.cparams, xu),
                                          mapping))
 
         for off, w, wb in data_lib.bucket_plan(len(self.row_list),
@@ -482,11 +528,11 @@ class _PrunedPending:
                 # the engine rows program takes each entry's combined-table
                 # index row (the grid gather happens host-side, where the
                 # first-mask ids live anyway)
-                t = pc._rows_incr(self.params, xg,
+                t = pc._rows_incr(self.cparams, xg,
                                   jnp.asarray(grid_full[mask_idx],
                                               dtype=jnp.int32))
             else:
-                t = pc._rows(self.params, xg,
+                t = pc._rows(self.cparams, xg,
                              jnp.asarray(mask_idx, dtype=jnp.int32))
             self.row_chunks.append(
                 (t, [(pos, b, i) for pos, (b, i) in enumerate(chunk)]))
@@ -538,7 +584,7 @@ class _PrunedPending:
                 xu = pc._mesh_place(
                     jnp.take(self.imgs, jnp.asarray(idx.reshape(-1)),
                              axis=0))
-                self.pair_chunks.append((pairs_prog(self.params, xu),
+                self.pair_chunks.append((pairs_prog(self.cparams, xu),
                                          mapping))
 
         per_rows = [[e for e in self.row_list if lo[s] <= e[0] < hi[s]]
@@ -564,11 +610,11 @@ class _PrunedPending:
                          axis=0))
             flat_masks = mask_idx.reshape(-1)
             if rowsets:
-                t = pc._rows_incr(self.params, xg,
+                t = pc._rows_incr(self.cparams, xg,
                                   jnp.asarray(grid_full[flat_masks],
                                               dtype=jnp.int32))
             else:
-                t = pc._rows(self.params, xg,
+                t = pc._rows(self.cparams, xg,
                              jnp.asarray(flat_masks, dtype=jnp.int32))
             self.row_chunks.append((t, mapping))
         return self
@@ -586,7 +632,10 @@ class _PrunedPending:
         pc = self.pc
         m, p = pc.num_first, pc.num_second
         p1, majority, unanimous = self.p1, self.majority, self.unanimous
-        margins_on = self.incr.split("-")[0] in ("token", "mixer")
+        # bf16 banks track margins on EVERY program (the dtype contract's
+        # escalation law); at f32 only the drift-carrying engine families
+        # (token, mixer) return them
+        margins_on = pc._bf16 or self.incr.split("-")[0] in ("token", "mixer")
         if margins_on and self.m1 is None:
             self.m1 = np.asarray(self.t1_margins)[:self.n]
 
@@ -687,16 +736,19 @@ class _PrunedPending:
         # per-image minimum top-2 logit margin over the evaluated
         # incremental entries; +inf without margins
         self.min_margin = min_margin
-        if self.incr.endswith("-exact"):
+        if self.incr.endswith("-exact") or pc._bf16:
             records = self._escalate(records, min_margin)
         return records
 
     def _escalate(self, records, min_margin) -> List[PatchCleanserRecord]:
-        """token/mixer-exact: re-run every image whose evaluated incremental
-        entries came within `incremental_margin` of the argmax boundary
-        through the exhaustive program (bucketed, one designed extra
-        dispatch); their records become exactly the oracle's, paying the
-        incremental cost already spent plus the full M + P sweep."""
+        """token/mixer-exact AND every bf16 bank: re-run every image whose
+        evaluated entries came within `incremental_margin` of the argmax
+        boundary through the f32 exhaustive program (bucketed, one designed
+        extra dispatch); their records become exactly the oracle's, paying
+        the cost already spent plus the full M + P sweep. This is the law
+        that lets bf16 never weaken a verdict: rounding can only flip a
+        label where the top-2 margin is small, and small-margin images are
+        exactly the ones re-certified at f32."""
         pc = self.pc
         esc = np.nonzero(min_margin < pc.config.incremental_margin)[0]
         if not esc.size:
@@ -719,6 +771,17 @@ class _PrunedPending:
             pred, cert, p1, p2 = map(
                 np.asarray,
                 pc._predict(self.params, xe, int(self.num_classes)))
+            if self.mode == "consensus":
+                # the consensus bank certifies on round-1 unanimity alone
+                # (the weaker opt-in certificate); the exhaustive program's
+                # cert bit is the full pair audit. Re-derive the consensus
+                # certificate from the f32 first-round table so an
+                # escalated record equals what the f32 consensus bank
+                # would have produced. The prediction needs no fixup: on
+                # unanimity both agree on the majority label, and on
+                # disagreement the exhaustive recovery reads the same full
+                # tables the consensus recovery reads.
+                cert = (p1 == p1[:, :1]).all(axis=1)
             for pos in range(cnt):
                 b = int(esc[off + pos])
                 old = records[b]
@@ -784,6 +847,18 @@ class PatchCleanser:
                                                 repr=False)
 
     def __post_init__(self):
+        if self.config.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"compute_dtype={self.config.compute_dtype!r} "
+                "(legal: float32, bfloat16)")
+        # bf16 certify bank: the pruned-path programs sweep in bfloat16
+        # (params cast once, images cast at the program boundary,
+        # preds/margins read out in f32) and every image whose evaluated
+        # margins land inside `incremental_margin` re-certifies through
+        # the f32 exhaustive program (`_PrunedPending._escalate`). The
+        # exhaustive `_predict` itself NEVER runs bf16 — it is the oracle.
+        self._bf16 = self.config.compute_dtype == "bfloat16"
+        self._cast_cache = None
         singles, doubles = masks_lib.mask_sets(self.spec)
         self._num_singles = singles.shape[0]
         self._num_doubles = doubles.shape[0]
@@ -828,6 +903,28 @@ class PatchCleanser:
             recompile_budget=self.recompile_budget)
         if self.spec.n_patch == 1:
             self._build_pruned_programs()
+
+    def _cast_params(self, params):
+        """The bf16 bank's once-cast weight tree (identity on f32 banks).
+
+        Floating leaves cast to bfloat16, everything else passes through;
+        a single-slot identity cache keyed on the ORIGINAL tree object
+        makes the cast free after the first dispatch (certify reuses one
+        weight tree for the whole run). The caller keeps the original tree
+        alive through `_PrunedPending.params` — also what the f32
+        escalation program consumes — so the `is` key cannot be recycled
+        mid-flight."""
+        if not self._bf16:
+            return params
+
+        def leaf(x):
+            x = jnp.asarray(x)
+            return (x.astype(jnp.bfloat16)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x)
+
+        if self._cast_cache is None or self._cast_cache[0] is not params:
+            self._cast_cache = (params, jax.tree_util.tree_map(leaf, params))
+        return self._cast_cache[1]
 
     def _mesh_place(self, x):
         """Place a host-gathered batch on the mesh: sharded over the data
@@ -881,17 +978,21 @@ class PatchCleanser:
                 b *= 2
             self.row_bucket_sizes = tuple(sorted(rungs))
 
+        cdt = self.config.compute_dtype
+
         def _phase1(params, imgs):
             return masked_predictions(
                 self.apply_fn, params, imgs, rects_first,
                 self.config.chunk_size, self.config.mask_fill,
-                self.config.use_pallas, mesh=self.mesh)
+                self.config.use_pallas, mesh=self.mesh,
+                compute_dtype=cdt, with_margins=self._bf16)
 
         def _pairs(params, imgs):
             return masked_predictions(
                 self.apply_fn, params, imgs, self._rects[m:],
                 self.config.chunk_size, self.config.mask_fill,
-                self.config.use_pallas, mesh=self.mesh)
+                self.config.use_pallas, mesh=self.mesh,
+                compute_dtype=cdt, with_margins=self._bf16)
 
         chunk_cap = max(1, int(self.config.chunk_size))
 
@@ -909,6 +1010,10 @@ class PatchCleanser:
             # fill is bitwise `ops.masked_fill`'s XLA reference path.
             idx_tab = self._grid_full[mask_idx]           # [W, M]
             size = self.spec.img_size
+            if self._bf16:
+                # program-boundary image cast (callers keep f32 batches,
+                # see `masked_predictions`); mk/fill follow imgs_g.dtype
+                imgs_g = imgs_g.astype(jnp.bfloat16)
             w_sz = int(imgs_g.shape[0])
             cap = max(1, chunk_cap // max(1, w_sz))
             g = max(d for d in range(1, m + 1)
@@ -920,11 +1025,18 @@ class PatchCleanser:
                 mk = mk.astype(imgs_g.dtype)
                 xt = jnp.tile(imgs_g, (g, 1, 1, 1))
                 xm = xt * mk + self.config.mask_fill * (1.0 - mk)
-                preds = jnp.argmax(self.apply_fn(params, xm), axis=-1)
-                return carry, preds.reshape(g, w_sz)
+                logits = self.apply_fn(params, xm)
+                if self._bf16:
+                    preds, margins = utils.preds_margins(logits)
+                    return carry, (preds.reshape(g, w_sz),
+                                   margins.reshape(g, w_sz))
+                return carry, jnp.argmax(logits, axis=-1).reshape(g, w_sz)
 
             cols = jnp.moveaxis(idx_tab, 0, 1).reshape(m // g, g, w_sz)
             _, out = jax.lax.scan(body, None, cols)
+            if self._bf16:
+                return tuple(jnp.moveaxis(t.reshape(m, w_sz), 0, 1)
+                             for t in out)                # [W, M] x 2
             return jnp.moveaxis(out.reshape(m, w_sz), 0, 1)   # [W, M]
 
         r = self.spec.patch_ratio
@@ -935,8 +1047,14 @@ class PatchCleanser:
         # single-chip entries stay distinct in the baseline registry. On a
         # mesh the pair audit dispatches at wave shapes over the row
         # ladder (not the caller's image buckets), so its trace budget is
-        # the row ladder's too.
-        tag = self._prog_tag = ".mesh" if self.mesh is not None else ""
+        # the row ladder's too. The bf16 bank is likewise a distinct
+        # program set (half-width sweeps, margin outputs): its `.bf16` tag
+        # composes with `.mesh` — `defense.phase1.bf16.r*`,
+        # `defense.phase1.bf16.mesh.r*` — so DP300/DP301 price both banks
+        # side by side.
+        dtag = ".bf16" if self._bf16 else ""
+        tag = self._prog_tag = dtag + (
+            ".mesh" if self.mesh is not None else "")
         osh = self._out_shardings
         pair_rb = row_rb if self.mesh is not None else rb
         self._phase1 = observe.timed_first_call(
@@ -965,7 +1083,8 @@ class PatchCleanser:
             fam = self.incremental_engine.build_family(
                 np.asarray(self._rects), m, self.config.chunk_size,
                 self.config.mask_fill,
-                use_pallas=self.config.use_pallas, mesh=self.mesh)
+                use_pallas=self.config.use_pallas, mesh=self.mesh,
+                compute_dtype=self.config.compute_dtype)
             self._incr_family = fam
             kind = self.incremental_engine.kind
             self._phase1_incr = observe.timed_first_call(
@@ -1168,10 +1287,17 @@ class PatchCleanser:
         meshed = self.mesh is not None
         place = self._mesh_place if meshed else (lambda x: x)
         S = self._mesh_data if meshed else 1
-        if mode.endswith("-exact") and num_classes is None:
+        # bf16 banks escalate through the f32 exhaustive program on small
+        # margins exactly like "-exact" — warm it under the same contract
+        esc_on = mode.endswith("-exact") or self._bf16
+        if esc_on and num_classes is None:
             raise ValueError(
-                f"warm_pruned needs num_classes under {mode} "
+                f"warm_pruned needs num_classes under "
+                f"{mode if mode.endswith('-exact') else 'bfloat16'} "
                 "(the escalation program's static argument)")
+        # warm against the once-cast tree: jit cache keys include the
+        # params avals, so live bf16 dispatch must hit these same traces
+        cparams = self._cast_params(params)
 
         def run(prog, *args):
             out = prog(*args)
@@ -1182,10 +1308,10 @@ class PatchCleanser:
 
         for b in bucket_sizes:
             imgs = full(b)
-            run(phase1, params, imgs)
+            run(phase1, cparams, imgs)
             if not meshed:
-                run(pairs, params, imgs)
-                if mode.endswith("-exact"):
+                run(pairs, cparams, imgs)
+                if esc_on:
                     run(self._predict, params, imgs, int(num_classes))
         m = self.num_first
         for w in self.row_bucket_sizes:
@@ -1195,12 +1321,12 @@ class PatchCleanser:
                 sets = jnp.asarray(
                     np.broadcast_to(np.asarray(self._grid_full)[0],
                                     (wave, m)).copy())
-                run(rows, params, imgs_g, sets)
+                run(rows, cparams, imgs_g, sets)
             else:
-                run(rows, params, imgs_g, jnp.zeros((wave,), jnp.int32))
+                run(rows, cparams, imgs_g, jnp.zeros((wave,), jnp.int32))
             if meshed:
-                run(pairs, params, imgs_g)
-                if mode.endswith("-exact"):
+                run(pairs, cparams, imgs_g)
+                if esc_on:
                     run(self._predict, params, full(w), int(num_classes))
 
     def pruned_trace_counts(self) -> dict:
@@ -1209,7 +1335,7 @@ class PatchCleanser:
         program under the "-exact" margin modes."""
         out = {name: int(fn._cache_size())
                for name, fn, _ in self.pruned_programs()}
-        if self.resolved_incremental().endswith("-exact"):
+        if self.resolved_incremental().endswith("-exact") or self._bf16:
             out[f"defense.predict.r{self.spec.patch_ratio}"] = \
                 int(self._predict._cache_size())
         return out

@@ -202,7 +202,7 @@ class ResMLPMixerFamily:
     def __init__(self, engine: "MixerPrunedResMLP", rects: np.ndarray,
                  num_singles: int, chunk_size: int, fill: float,
                  use_pallas: str = "auto", mesh=None,
-                 data_axis: str = "data"):
+                 data_axis: str = "data", compute_dtype: str = "float32"):
         self.engine = engine
         self.num_singles = int(num_singles)
         self.chunk_size = max(1, int(chunk_size))
@@ -214,11 +214,23 @@ class ResMLPMixerFamily:
         self.use_pallas = use_pallas
         self.mesh = mesh
         self.data_axis = data_axis
+        self.compute_dtype = jnp.dtype(compute_dtype)
         img, patch = engine.img_size, engine.patch
         self.first = _build_mixer_tables(rects[:num_singles], img, patch)
         self.pair_tables = _build_mixer_tables(rects[num_singles:], img,
                                                patch)
         self.combined = _build_mixer_tables(rects, img, patch)
+        if self.compute_dtype != jnp.float32:
+            # cast the static float tables once at family build: a bf16
+            # activation times an f32 keep mask or slot weight silently
+            # promotes the chain back to f32 (the DP208 leak)
+            def cast(t):
+                return t._replace(keep=t.keep.astype(self.compute_dtype),
+                                  w=t.w.astype(self.compute_dtype))
+
+            self.first = cast(self.first)
+            self.pair_tables = cast(self.pair_tables)
+            self.combined = cast(self.combined)
         self.fe = self.combined.fe
         self.fe_first = float(self.fe[:num_singles].sum())
         self.fe_pairs = float(self.fe[num_singles:].sum())
@@ -228,15 +240,19 @@ class ResMLPMixerFamily:
         self.cache_fe = 1.0
 
     def phase1(self, params, imgs):
-        return self.engine._table(params, imgs, self.first,
-                                  self.fill, self.chunk_size)
+        # program-boundary image cast (no-op at f32): callers hand f32
+        # batches regardless of the bank's sweep dtype
+        return self.engine._table(params, imgs.astype(self.compute_dtype),
+                                  self.first, self.fill, self.chunk_size)
 
     def pairs(self, params, imgs):
-        return self.engine._table(params, imgs, self.pair_tables,
-                                  self.fill, self.chunk_size)
+        return self.engine._table(params, imgs.astype(self.compute_dtype),
+                                  self.pair_tables, self.fill,
+                                  self.chunk_size)
 
     def rows(self, params, imgs_g, sets_idx):
-        return self.engine._rows(params, imgs_g, sets_idx, self.combined,
+        return self.engine._rows(params, imgs_g.astype(self.compute_dtype),
+                                 sets_idx, self.combined,
                                  self.fill, self.chunk_size)
 
 
@@ -266,10 +282,12 @@ class MixerPrunedResMLP:
     def build_family(self, rects: np.ndarray, num_singles: int,
                      chunk_size: int, fill: float,
                      use_pallas: str = "auto", mesh=None,
-                     data_axis: str = "data") -> ResMLPMixerFamily:
+                     data_axis: str = "data",
+                     compute_dtype: str = "float32") -> ResMLPMixerFamily:
         return ResMLPMixerFamily(self, rects, num_singles, chunk_size,
                                  fill, use_pallas=use_pallas, mesh=mesh,
-                                 data_axis=data_axis)
+                                 data_axis=data_axis,
+                                 compute_dtype=compute_dtype)
 
     # ------------------------------------------------------------ internals
 

@@ -78,10 +78,13 @@ class GroupNormRelu(nn.Module):
     """GroupNorm(32, eps=1e-5) + ReLU (timm GroupNormAct).
 
     Statistics are computed in float32 for numerical parity with the torch
-    reference, but the output is cast back to the input dtype: under the
-    attack's bfloat16 mixed precision the surrounding convs must see bf16
-    activations, or every conv after the first GN silently runs on f32
-    activations at 2x the HBM traffic (measured ~26 TFLOP/s vs ~60+ fixed).
+    reference, but everything elementwise stays at the input dtype: under
+    the attack's bfloat16 mixed precision (and the bf16 certify bank) the
+    surrounding convs must see bf16 activations, or every conv after the
+    first GN silently runs on f32 activations at 2x the HBM traffic
+    (measured ~26 TFLOP/s vs ~60+ fixed) — and flax's own GroupNorm would
+    additionally materialize the normalize chain itself in f32
+    (`fused_gn.gn_preserve_dtype` keeps it at x.dtype).
 
     impl: "auto" (fused Pallas kernel on single-device TPU backends — XLA's
     GN *backward* costs ~23% of the attack step, see `ops/fused_gn.py` —
@@ -99,13 +102,23 @@ class GroupNormRelu(nn.Module):
         impl = self.impl
         if impl == "auto":
             impl = "pallas" if fused_gn.auto_pallas(x.shape, x.dtype) else "flax"
-        if impl == "flax":
-            dt = x.dtype
-            x = nn.GroupNorm(
-                num_groups=self.num_groups, epsilon=1e-5, dtype=jnp.float32,
-                name="GroupNorm_0")(x)
-            return nn.relu(x).astype(dt)
         scale, bias = _GNParams(x.shape[-1], name="GroupNorm_0")()
+        if impl == "flax":
+            if x.dtype == jnp.float32:
+                # parent=None: construct outside the compact scope, else
+                # flax auto-registers a "GroupNorm_0" child colliding with
+                # the _GNParams shadow holder above.
+                y = nn.GroupNorm(
+                    num_groups=self.num_groups, epsilon=1e-5,
+                    dtype=jnp.float32, parent=None).apply(
+                        {"params": {"scale": scale, "bias": bias}}, x)
+                return nn.relu(y)
+            # Sub-f32 activations (bf16 attack / certify banks): flax's
+            # GroupNorm materializes the whole normalize chain in f32;
+            # keep the big elementwise tensors at x.dtype instead.
+            y = fused_gn.gn_preserve_dtype(
+                x, scale, bias, self.num_groups, eps=1e-5)
+            return nn.relu(y)
         return fused_gn.gn_relu(x, scale, bias, self.num_groups, impl=impl)
 
 

@@ -14,6 +14,34 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
+class GroupNorm8(nn.Module):
+    """GroupNorm(8) that keeps sub-f32 activations at their own dtype.
+
+    float32 inputs go through flax's `nn.GroupNorm` verbatim (applied
+    functionally against this module's own `scale`/`bias`, so the f32
+    parameter tree and numerics are bit-identical to declaring it inline).
+    Lower-precision inputs — the bf16 certify bank casts the victim's
+    params and images down — use `fused_gn.gn_preserve_dtype`: f32
+    statistics, input-dtype normalization. flax would materialize the
+    whole normalize chain in f32, which both doubles the real HBM traffic
+    of the big intermediates and prices the bf16 defense programs above
+    their f32 twins in the DP301 baseline bank.
+    """
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        if x.dtype == jnp.float32:
+            # parent=None keeps this an unbound functional apply; without
+            # it flax registers a child module in GroupNorm8's own scope.
+            return nn.GroupNorm(num_groups=8, parent=None).apply(
+                {"params": {"scale": scale, "bias": bias}}, x)
+        from dorpatch_tpu.ops import fused_gn
+        return fused_gn.gn_preserve_dtype(x, scale, bias, 8, eps=1e-6)
+
+
 class BasicBlock(nn.Module):
     features: int
     stride: int = 1
@@ -22,13 +50,13 @@ class BasicBlock(nn.Module):
     def __call__(self, x):
         y = nn.Conv(self.features, (3, 3), (self.stride, self.stride), padding=1,
                     use_bias=False, name="conv1")(x)
-        y = nn.relu(nn.GroupNorm(num_groups=8, name="norm1")(y))
+        y = nn.relu(GroupNorm8(name="norm1")(y))
         y = nn.Conv(self.features, (3, 3), padding=1, use_bias=False, name="conv2")(y)
-        y = nn.GroupNorm(num_groups=8, name="norm2")(y)
+        y = GroupNorm8(name="norm2")(y)
         if x.shape[-1] != self.features or self.stride != 1:
             x = nn.Conv(self.features, (1, 1), (self.stride, self.stride),
                         use_bias=False, name="proj")(x)
-            x = nn.GroupNorm(num_groups=8, name="proj_norm")(x)
+            x = GroupNorm8(name="proj_norm")(x)
         return nn.relu(x + y)
 
 
@@ -52,7 +80,7 @@ class CifarResNet18(nn.Module):
             x = nn.Conv(64, (3, 3), padding=1, use_bias=False, name="stem")(x)
             if mode == "stem":
                 return x
-        x = nn.relu(nn.GroupNorm(num_groups=8, name="stem_norm")(x))
+        x = nn.relu(GroupNorm8(name="stem_norm")(x))
         features = 64
         for si, depth in enumerate(self.stage_sizes):
             for bi in range(depth):

@@ -54,6 +54,44 @@ from dorpatch_tpu.ops import _backend
 from dorpatch_tpu.ops import masked_kv_attn
 
 
+def _fast_ln(x, scale, bias, eps=1e-6):
+    """flax `nn.LayerNorm` twin (fast-variance formula) at x.dtype: the
+    per-token statistics accumulate in f32 (jnp.mean upcasts half-precision
+    reductions internally and converts straight back), but the normalize
+    chain's slab-sized tensors stay at the input dtype."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    mean2 = jnp.mean(x * x, axis=-1, keepdims=True)
+    var = jnp.maximum(0.0, mean2 - mean * mean)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return y * scale + bias
+
+
+class LayerNormDT(nn.Module):
+    """LayerNorm that keeps sub-f32 activations at their own dtype.
+
+    float32 inputs go through flax's `nn.LayerNorm` verbatim (applied
+    functionally against this module's own `scale`/`bias`, so the f32
+    parameter tree and numerics are bit-identical to declaring it inline).
+    Lower-precision inputs — the bf16 certify bank casts the victim's
+    params and images down — use `_fast_ln`: flax's `_normalize`
+    materializes the whole chain in f32 even under `dtype=bfloat16`,
+    which is exactly the slab leak DP208 flags inside bf16 banks."""
+
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        if x.dtype == jnp.float32:
+            # parent=None keeps this an unbound functional apply; without
+            # it flax registers a child module in LayerNormDT's own scope
+            return nn.LayerNorm(epsilon=self.epsilon, parent=None).apply(
+                {"params": {"scale": scale, "bias": bias}}, x)
+        return _fast_ln(x, scale, bias, self.epsilon)
+
+
 class ViTBlock(nn.Module):
     dim: int
     num_heads: int
@@ -61,7 +99,7 @@ class ViTBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        y = nn.LayerNorm(epsilon=1e-6, name="norm1")(x)
+        y = LayerNormDT(name="norm1")(x)
         y = nn.MultiHeadDotProductAttention(
             num_heads=self.num_heads,
             qkv_features=self.dim,
@@ -70,7 +108,7 @@ class ViTBlock(nn.Module):
             name="attn",
         )(y, y)
         x = x + y
-        y = nn.LayerNorm(epsilon=1e-6, name="norm2")(x)
+        y = LayerNormDT(name="norm2")(x)
         y = nn.Dense(self.dim * self.mlp_ratio, name="mlp_fc1")(y)
         y = nn.gelu(y, approximate=False)
         y = nn.Dense(self.dim, name="mlp_fc2")(y)
@@ -116,7 +154,7 @@ class ViT(nn.Module):
             if mode == "cache" and i == self.depth - 1:
                 return tuple(cache)
             x = ViTBlock(self.dim, self.num_heads, name=f"block{i}")(x)
-        x = nn.LayerNorm(epsilon=1e-6, name="norm")(x)
+        x = LayerNormDT(name="norm")(x)
         return nn.Dense(self.num_classes, name="head")(x[:, 0])
 
 
@@ -214,7 +252,7 @@ class TokenViTFamily:
     def __init__(self, engine: "TokenPrunedViT", rects: np.ndarray,
                  num_singles: int, chunk_size: int, fill: float,
                  use_pallas: str = "auto", mesh=None,
-                 data_axis: str = "data"):
+                 data_axis: str = "data", compute_dtype: str = "float32"):
         self.engine = engine
         self.num_singles = int(num_singles)
         self.chunk_size = max(1, int(chunk_size))
@@ -222,10 +260,26 @@ class TokenViTFamily:
         self.use_pallas = use_pallas
         self.mesh = mesh
         self.data_axis = data_axis
+        self.compute_dtype = jnp.dtype(compute_dtype)
         img, patch = engine.img_size, engine.patch
         self.first = _build_tables(rects[:num_singles], img, patch)
         self.pair_tables = _build_tables(rects[num_singles:], img, patch)
         self.combined = _build_tables(rects, img, patch)
+        if self.compute_dtype != jnp.float32:
+            # cast the static float tables once at family build: a bf16
+            # activation times an f32 keep mask (or plus an f32 slot bias)
+            # silently promotes the whole chain back to f32 — exactly the
+            # leak DP208 flags — so the tables follow the sweep dtype.
+            # (-1e9 bias sentinels are exactly representable in bf16: same
+            # exponent range as f32.)
+            def cast(t):
+                return t._replace(
+                    keep=t.keep.astype(self.compute_dtype),
+                    slot_bias=t.slot_bias.astype(self.compute_dtype))
+
+            self.first = cast(self.first)
+            self.pair_tables = cast(self.pair_tables)
+            self.combined = cast(self.combined)
         self.fe = self.combined.fe
         self.fe_first = float(self.fe[:num_singles].sum())
         self.fe_pairs = float(self.fe[num_singles:].sum())
@@ -241,19 +295,24 @@ class TokenViTFamily:
     # the three program bodies defense.py wraps in jax.jit ----------------
 
     def phase1(self, params, imgs):
-        return self.engine._table(params, imgs, self.first,
+        # program-boundary image cast (no-op at f32): callers hand f32
+        # batches regardless of the bank's sweep dtype
+        return self.engine._table(params, imgs.astype(self.compute_dtype),
+                                  self.first,
                                   self.fill, self.chunk_size,
                                   self.use_pallas, self.mesh,
                                   self.data_axis)
 
     def pairs(self, params, imgs):
-        return self.engine._table(params, imgs, self.pair_tables,
+        return self.engine._table(params, imgs.astype(self.compute_dtype),
+                                  self.pair_tables,
                                   self.fill, self.chunk_size,
                                   self.use_pallas, self.mesh,
                                   self.data_axis)
 
     def rows(self, params, imgs_g, sets_idx):
-        return self.engine._rows(params, imgs_g, sets_idx, self.combined,
+        return self.engine._rows(params, imgs_g.astype(self.compute_dtype),
+                                 sets_idx, self.combined,
                                  self.fill, self.chunk_size,
                                  self.use_pallas, self.mesh,
                                  self.data_axis)
@@ -284,10 +343,12 @@ class TokenPrunedViT:
     def build_family(self, rects: np.ndarray, num_singles: int,
                      chunk_size: int, fill: float,
                      use_pallas: str = "auto", mesh=None,
-                     data_axis: str = "data") -> TokenViTFamily:
+                     data_axis: str = "data",
+                     compute_dtype: str = "float32") -> TokenViTFamily:
         return TokenViTFamily(self, rects, num_singles, chunk_size, fill,
                               use_pallas=use_pallas, mesh=mesh,
-                              data_axis=data_axis)
+                              data_axis=data_axis,
+                              compute_dtype=compute_dtype)
 
     # ------------------------------------------------------------ internals
 
@@ -312,14 +373,10 @@ class TokenPrunedViT:
 
     @staticmethod
     def _ln(x, p, eps=1e-6):
-        """flax `nn.LayerNorm` twin (fast-variance formula) over params
-        {scale, bias} — applied manually so the incremental blocks can run
-        straight off the parameter tree."""
-        mean = jnp.mean(x, axis=-1, keepdims=True)
-        mean2 = jnp.mean(x * x, axis=-1, keepdims=True)
-        var = jnp.maximum(0.0, mean2 - mean * mean)
-        y = (x - mean) * jax.lax.rsqrt(var + eps)
-        return y * p["scale"] + p["bias"]
+        """flax `nn.LayerNorm` twin over params {scale, bias} — applied
+        manually so the incremental blocks can run straight off the
+        parameter tree (shared `_fast_ln` math, dtype-preserving)."""
+        return _fast_ln(x, p["scale"], p["bias"], eps)
 
     def _clean_kv(self, params, cache):
         """Per-block clean KEY/VALUE projections of the cached activations
@@ -372,7 +429,10 @@ class TokenPrunedViT:
         # dirty positions (their cached K/V is stale; the dirty group
         # carries the fresh rows). Mask geometry is layer-independent.
         stale = jnp.any(idx[..., None] == jnp.arange(t1), axis=-2)
-        stale_bias = jnp.where(stale, -1e9, 0.0)
+        # explicit cast: jnp.where over python scalars yields a weak f32
+        # array, and adding it to bf16 attention logits relies on weak-type
+        # promotion staying bf16 — pin the bias to the sweep dtype instead
+        stale_bias = jnp.where(stale, -1e9, 0.0).astype(d.dtype)
         clean_bias = stale_bias[..., None, None, :]
         dirty_bias = slot_bias[..., None, None, :]
         for layer in range(self.module.depth):

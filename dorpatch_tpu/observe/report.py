@@ -158,6 +158,28 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
     # pre-incremental telemetry so the two totals coincide there
     certify_fe = sum(float(s.get("forward_equivalents", s.get("forwards", 0)))
                      for s in certify_spans)
+    # mixed-precision accounting (bf16 certify bank): each certify span is
+    # stamped with the DefenseConfig.compute_dtype it ran under; when one
+    # results dir holds BOTH banks (an A/B run, or two attempts at
+    # different precisions) the per-dtype image rates give the measured
+    # speedup directly. Pre-bf16 telemetry carries no dtype attr -> None.
+    dtype_rates: Dict[str, dict] = {}
+    for s in certify_spans:
+        dt = s.get("compute_dtype")
+        if not dt:
+            continue
+        r = dtype_rates.setdefault(str(dt), {"seconds": 0.0, "images": 0})
+        r["seconds"] += float(s.get("dur_s", 0.0))
+        r["images"] += int(s.get("images", 0))
+    certify_dtype = "+".join(sorted(dtype_rates)) if dtype_rates else None
+    certify_dtype_speedup = None
+    if {"f32", "bf16"} <= set(dtype_rates):
+        f32, b16 = dtype_rates["f32"], dtype_rates["bf16"]
+        if f32["seconds"] and b16["seconds"] and f32["images"] \
+                and b16["images"]:
+            certify_dtype_speedup = round(
+                (b16["images"] / b16["seconds"])
+                / (f32["images"] / f32["seconds"]), 3)
 
     peak_mem = 0
     for b in blocks:
@@ -227,6 +249,8 @@ def summarize(result_dir: str, stall_factor: float = 5.0) -> dict:
             if certify_fwd and certify_exh else None,
             "exhaustive_speedup": round(certify_exh / certify_fe, 2)
             if certify_fe and certify_exh else None,
+            "compute_dtype": certify_dtype,
+            "dtype_speedup": certify_dtype_speedup,
         },
         "mfu": mfu,
         "serve": serve,
@@ -280,6 +304,10 @@ def _summarize_serve(ev: List[dict]) -> Optional[dict]:
     wall = (max(ts) - min(ts)) if len(ts) >= 2 else 0.0
     images = sum(int(b.get("images", 0)) for b in batches)
     slots = sum(int(b.get("bucket", 0)) for b in batches)
+    # the certify-bank precision the replicas batched under (stamped per
+    # serve.batch span); absent on pre-bf16 telemetry
+    dtypes = sorted({str(b["compute_dtype"]) for b in batches
+                     if b.get("compute_dtype")})
     return {
         "requests": total,
         "by_status": dict(sorted(by_status.items())),
@@ -299,6 +327,7 @@ def _summarize_serve(ev: List[dict]) -> Optional[dict]:
         if fe and ok_lat else None,
         "certify_prune_rate": round(1.0 - fwd / fwd_exh, 4)
         if fwd and fwd_exh else None,
+        "compute_dtype": "+".join(dtypes) if dtypes else None,
     }
 
 
@@ -521,8 +550,12 @@ def format_report(s: dict) -> str:
     add(f"  attack: {a['steps']} steps in {a['seconds']}s -> "
         f"{a['steps_per_sec']} steps/sec; {a['images_generated']} images "
         f"generated -> {a['images_per_sec']} images/sec")
-    add(f"  certify: {ce['images']} images in {ce['seconds']}s -> "
+    dt = f" [{ce['compute_dtype']}]" if ce.get("compute_dtype") else ""
+    add(f"  certify{dt}: {ce['images']} images in {ce['seconds']}s -> "
         f"{ce['images_per_sec']} images/sec")
+    if ce.get("dtype_speedup") is not None:
+        add(f"  certify dtype speedup: {ce['dtype_speedup']}x "
+            "(bf16 vs f32 images/sec, both banks in this dir)")
     if ce.get("forwards_per_image"):
         prune = (f", prune rate {100.0 * ce['prune_rate']:.1f}%, "
                  f"{ce['exhaustive_speedup']}x vs exhaustive"
@@ -547,7 +580,9 @@ def format_report(s: dict) -> str:
     if sv:
         add("-- serve --")
         statuses = ", ".join(f"{k}: {v}" for k, v in sv["by_status"].items())
-        add(f"  requests: {sv['requests']} ({statuses})")
+        add(f"  requests: {sv['requests']} ({statuses})"
+            + (f", certify bank {sv['compute_dtype']}"
+               if sv.get("compute_dtype") else ""))
         lat = sv["latency_ms"]
         if lat["count"]:
             add(f"  latency: p50 {lat['p50']} ms, p95 {lat['p95']} ms, "
@@ -619,6 +654,10 @@ def format_report(s: dict) -> str:
                 + ", ".join(f"{k}: {v}" for k, v in sorted(by_rule.items())))
             for f in (bl.get("findings") or [])[:8]:
                 add(f"  {f.get('rule', '?')} {f.get('message', '')[:110]}")
+        db = bl.get("dtype_bytes")
+        if db and db.get("ratio") is not None:
+            add(f"  bf16 bank: {db['paired_entries']} entry pair(s), "
+                f"predicted HBM bytes ratio {db['ratio']} vs f32 twins")
         rows = bl.get("intensity") or []
         if rows:
             # estimated bytes accessed + arithmetic intensity (flops/byte)

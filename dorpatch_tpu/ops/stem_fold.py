@@ -171,7 +171,10 @@ def fold_masked_stem(kernel: jax.Array, clean: jax.Array, u: jax.Array,
     b = clean.shape[0]
     out = jnp.broadcast_to(clean[:, None], (b, len(plan)) + clean.shape[1:])
     for n, w in enumerate(plan):
-        win = up[:, w.i0:w.i1, w.ic0:w.ic1, :] * jnp.asarray(w.occ)
+        # occ is stored f32 host-side; match the input dtype so the bf16
+        # certify bank's window product does not silently upcast (DP208)
+        win = up[:, w.i0:w.i1, w.ic0:w.ic1, :] \
+            * jnp.asarray(w.occ, dtype=up.dtype)
         d = _delta_conv(win, kernel, int(strides[0]))
         out = out.at[:, n, w.o0:w.o1, w.oc0:w.oc1, :].add(
             d.astype(out.dtype))
@@ -262,7 +265,7 @@ def fold_masked_stem_kernel(kernel: jax.Array, clean: jax.Array,
         ),
         out_shape=jax.ShapeDtypeStruct((b, n, h, w, c), clean.dtype),
         interpret=interpret,
-    )(jnp.asarray(geo), up, jnp.asarray(occ), clean, kernel)
+    )(jnp.asarray(geo), up, jnp.asarray(occ, dtype=up.dtype), clean, kernel)
 
 
 def fold_masked_stem_sharded(kernel: jax.Array, clean: jax.Array,
@@ -308,8 +311,10 @@ class StemFoldFamily:
     def __init__(self, engine: "StemFoldEngine", rects: np.ndarray,
                  num_singles: int, chunk_size: int, fill: float,
                  use_pallas: str = "auto", mesh=None,
-                 data_axis: str = "data"):
+                 data_axis: str = "data",
+                 compute_dtype: str = "float32"):
         self.engine = engine
+        self.compute_dtype = jnp.dtype(compute_dtype)
         self.num_singles = int(num_singles)
         self.chunk_size = max(1, int(chunk_size))
         self.fill = float(fill)
@@ -331,6 +336,12 @@ class StemFoldFamily:
         eng = self.engine
         b, h, w, ci = imgs.shape
         n = len(self.plan)
+        # program-boundary cast: callers keep f32 batches (stable jit cache
+        # keys); under the bf16 certify bank the image, the fill-delta `u`
+        # and the occ windows all flow at compute_dtype, with f32
+        # accumulation inside `_delta_conv` and f32 margin readout
+        if imgs.dtype != self.compute_dtype:
+            imgs = imgs.astype(self.compute_dtype)
         xn = eng.normalize(imgs)
         clean = eng.module.apply(params, xn, "stem")
         u = eng.norm_scale * (self.fill - imgs)
@@ -409,7 +420,9 @@ class StemFoldEngine:
     def build_family(self, rects: np.ndarray, num_singles: int,
                      chunk_size: int, fill: float,
                      use_pallas: str = "auto", mesh=None,
-                     data_axis: str = "data") -> StemFoldFamily:
+                     data_axis: str = "data",
+                     compute_dtype: str = "float32") -> StemFoldFamily:
         return StemFoldFamily(self, rects, num_singles, chunk_size, fill,
                               use_pallas=use_pallas, mesh=mesh,
-                              data_axis=data_axis)
+                              data_axis=data_axis,
+                              compute_dtype=compute_dtype)

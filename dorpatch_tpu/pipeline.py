@@ -188,10 +188,21 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
         records: List[List] = []
 
         data_source = resolved_data_source(cfg)
-        batches = dataset_batches(
-            cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size, cfg.seed,
-            source=data_source,
-        )
+        if cfg.stream_depth > 0 and not multi:
+            # streaming input path: background chunked reads + double-
+            # buffered device prefetch (data.streaming_batches). Multi-
+            # process feeding keeps the synchronous path — its per-process
+            # local shards go through place_replicated below, which needs
+            # the host array.
+            batches = data.streaming_batches(
+                cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size,
+                cfg.seed, source=data_source, depth=cfg.stream_depth,
+                mesh=mesh)
+        else:
+            batches = dataset_batches(
+                cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size,
+                cfg.seed, source=data_source,
+            )
         timer = observe.StepTimer()
         generated_images = 0
         batch_iter = enumerate(batches)
@@ -324,8 +335,12 @@ def run_experiment(cfg: ExperimentConfig, verbose: bool = True) -> Dict:
                         len(r) != len(defenses) for r in recs):
                     recs = None
                 if recs is None:
-                    with observe.span("certify", batch=i,
-                                      images=int(x.shape[0])) as sp_cert:
+                    with observe.span(
+                            "certify", batch=i, images=int(x.shape[0]),
+                            compute_dtype=(
+                                "bf16"
+                                if cfg.defense.compute_dtype == "bfloat16"
+                                else "f32")) as sp_cert:
                         per_defense = [
                             d.robust_predict(victim.params, adv_x,
                                              victim.num_classes,

@@ -469,16 +469,23 @@ class CertifiedInferenceService:
     def _warm_bank(self, clean, defenses, replica: int = 0) -> None:
         """Warm ONE replica's program bank (see `warmup`); replica 0's bank
         is the service's own, the pool warms the others through here."""
-        for b in self.bucket_sizes:
+        # warmup dummies ride the streaming prefetcher
+        # (data.prefetch_to_device): the host->device transfer for bucket
+        # N+1 is issued while bucket N compiles and dispatches, and warm
+        # placements go through the same placement rule as live traffic
+        def dummies():
+            for b in self.bucket_sizes:
+                yield (np.full((b, self.img_size, self.img_size, 3), 0.5,
+                               np.float32), np.zeros((b,), np.int64))
+
+        placed_stream = data_lib.prefetch_to_device(dummies(), depth=2)
+        for b, (placed, _) in zip(self.bucket_sizes, placed_stream):
             t0 = self._clock()
-            dummy = np.full((b, self.img_size, self.img_size, 3), 0.5,
-                            np.float32)
             if self.prune == "off":
                 logits, per_defense = self._dispatch(
-                    jax.device_put(dummy), b, clean=clean, defenses=defenses)
+                    placed, b, clean=clean, defenses=defenses)
             else:
-                logits, per_defense = clean(self.params,
-                                            jax.device_put(dummy)), []
+                logits, per_defense = clean(self.params, placed), []
             # marshalling doubles as the completion sync for the warmup call
             marshal_response([], logits, per_defense, self.ratios, b,
                              clock=self._clock)
@@ -768,6 +775,9 @@ class CertifiedInferenceService:
         # scheduler skipped (0.0 when prune=off)
         s["prune"] = self.prune
         s["incremental"] = self.incremental
+        # the certify sweep precision this service's program bank runs at
+        # (DefenseConfig.compute_dtype: "float32" | "bfloat16")
+        s["compute_dtype"] = self.defense_cfg.compute_dtype
         fwd = int(v("serve_certify_forwards_total"))
         exh = int(v("serve_certify_forwards_exhaustive_total"))
         fe = float(v("serve_certify_forward_equivalents_total"))
@@ -889,6 +899,10 @@ class CertifiedInferenceService:
         with observe.span("serve.batch", bucket=int(bucket), images=n,
                           replica=slot,
                           queue_depth=self.batcher.qsize(),
+                          compute_dtype=(
+                              "bf16"
+                              if self.defense_cfg.compute_dtype == "bfloat16"
+                              else "f32"),
                           traces=[r.trace_id for r in reqs]) as sp:
             # pad on the host so exactly ONE host->device transfer
             # happens per batch, always bucket-shaped

@@ -36,7 +36,7 @@ from dorpatch_tpu import losses, metrics, observe
 from dorpatch_tpu.attack import DorPatch
 from dorpatch_tpu.config import (AttackConfig, DefenseConfig, ExperimentConfig,
                                   resolved_data_source)
-from dorpatch_tpu.data import dataset_batches
+from dorpatch_tpu.data import dataset_batches, streaming_batches
 from dorpatch_tpu.defense import build_defenses
 from dorpatch_tpu.models import get_model
 
@@ -114,10 +114,22 @@ def run_sweep(
     victim = get_model(cfg.dataset, cfg.base_arch, cfg.model_dir, cfg.img_size,
                        gn_impl=cfg.gn_impl)
     data_source = resolved_data_source(cfg)
-    x_np, y_np = next(iter(dataset_batches(
-        cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size, cfg.seed,
-        source=data_source,
-    )))
+    if cfg.stream_depth > 0:
+        # the streaming input path (background reads + device prefetch):
+        # the sweep consumes one batch, so this mainly buys the overlapped
+        # decode+transfer while the victim's first forward compiles
+        batch_iter = streaming_batches(
+            cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size,
+            cfg.seed, source=data_source, depth=cfg.stream_depth)
+    else:
+        batch_iter = iter(dataset_batches(
+            cfg.dataset, cfg.data_dir, cfg.batch_size, cfg.img_size,
+            cfg.seed, source=data_source,
+        ))
+    x_np, y_np = next(batch_iter)
+    close = getattr(batch_iter, "close", None)
+    if close is not None:
+        close()
     x = jnp.asarray(x_np)
     preds = jnp.argmax(victim.apply(victim.params, x), -1)
     if data_source == "synthetic":

@@ -84,13 +84,17 @@ def select_device(device: str = "0") -> Optional[jax.Device]:
 
 
 def preds_margins(logits):
-    """(argmax predictions int32, top-1/top-2 logit gaps) of a logits
-    array over its last axis — THE escalation signal of the incremental
-    certify engines (`models/vit.py`, `ops/stem_fold.py` share this one
-    definition so the token and stem margin semantics cannot drift)."""
+    """(argmax predictions int32, top-1/top-2 logit gaps float32) of a
+    logits array over its last axis — THE escalation signal of the
+    incremental certify engines (`models/vit.py`, `ops/stem_fold.py` share
+    this one definition so the token and stem margin semantics cannot
+    drift). Margins are read out in float32 regardless of the logits dtype:
+    under the bf16 certify banks this is the single deliberate upcast at
+    the program boundary (the dtype contract's "logits/margins read out in
+    f32"), exempted from the DP208 promotion-leak lint by design."""
     import jax.numpy as jnp
     from jax import lax
 
-    top2 = lax.top_k(logits, 2)[0]
+    top2 = lax.top_k(logits, 2)[0].astype(jnp.float32)  # noqa: DP208
     return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
             top2[..., 0] - top2[..., 1])

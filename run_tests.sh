@@ -98,6 +98,18 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   | grep -q '"parity": true' \
   || { echo "certify-incr smoke: parity/forward-equivalents violation"; exit 1; }
 echo "certify incr smoke: OK"
+# Smoke: mixed-precision certification — the same seeded batch certified at
+# compute_dtype="float32" and "bfloat16" must yield identical verdicts
+# (identical-or-escalated: near-boundary images re-run the f32 exhaustive
+# program), and every defense.*.bf16.* entry in the checked-in program
+# baseline bank must predict STRICTLY fewer HBM bytes than its f32 twin
+# (tools/certify_bf16_smoke.py exits non-zero and lists the violations
+# otherwise).
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python tools/certify_bf16_smoke.py \
+  | grep -q '"parity": true' \
+  || { echo "certify-bf16 smoke: parity/bytes violation"; exit 1; }
+echo "certify bf16 smoke: OK"
 # Smoke: the Pallas kernel tier — the same seeded batch through the
 # engine-backed pruned certify with use_pallas="off" (pure XLA) and
 # use_pallas="interpret" (the kernel bodies emulated on CPU; the lowered

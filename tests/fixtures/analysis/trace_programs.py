@@ -151,6 +151,54 @@ def live_donation():
     return _ep("fx.live_donation", program, jnp.zeros((4,)))
 
 
+def silent_upcast():
+    """DP208: inside a declared-bf16 program (`.bf16` in the name), a bf16
+    slab meets an f32 constant and promotion silently lands the product in
+    f32 — the exact leak flax's GroupNorm introduced in the bf16 bank.
+    The slab is deliberately above the rule's readout-size exemption."""
+
+    @jax.jit
+    def program(x):
+        h = x.astype(jnp.bfloat16)
+        return h * jnp.asarray(2.0, jnp.float32)  # bf16 x f32 -> silent f32
+
+    return _ep("fx.phase1.bf16.upcast", program, jnp.zeros((128, 128)))
+
+
+def explicit_upcasts():
+    """Clean twin of silent_upcast: the same bank-tagged program, but every
+    f32 landing is declared — the readout goes through an explicit
+    `astype` (convert_element_type) and the matmul requests its f32
+    accumulator via `preferred_element_type`."""
+
+    @jax.jit
+    def program(x, w):
+        h = x.astype(jnp.bfloat16)
+        # f32 stats that reduce straight back down (the E[x^2] idiom)
+        hf = h.astype(jnp.float32)
+        mean = jnp.mean(hf * hf)
+        acc = lax.dot(h, w.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+        logits = acc.astype(jnp.bfloat16) * jnp.asarray(0.5, jnp.bfloat16)
+        return logits.astype(jnp.float32), mean  # the visible f32 readout
+
+    return _ep("fx.phase1.bf16.clean", program, jnp.zeros((128, 128)),
+               jnp.zeros((128, 128)))
+
+
+def untagged_upcast():
+    """Clean by scope: identical math to silent_upcast but the program is
+    not declared bf16 (no `.bf16` tag), so DP208 stays out of it — mixed
+    precision outside the certified banks is the attack's business."""
+
+    @jax.jit
+    def program(x):
+        h = x.astype(jnp.bfloat16)
+        return h * jnp.asarray(2.0, jnp.float32)
+
+    return _ep("fx.phase1.mixed", program, jnp.zeros((4, 4)))
+
+
 #: rule id -> (positive builder, clean twin)
 PER_RULE = {
     "DP201": (weak_carry, stable_carry),
@@ -159,15 +207,17 @@ PER_RULE = {
     "DP204": (dead_matmul, None),
     "DP205": (unbound_axis, bound_axis),
     "DP206": (dead_donation, live_donation),
+    "DP208": (silent_upcast, explicit_upcasts),
 }
 
 
 def bad_entrypoints():
     """--entrypoints payload: every positive fixture (CLI must exit 1)."""
     return [scan_carry(), weak_carry(), weak_output(), host_const(),
-            dead_matmul(), unbound_axis(), dead_donation()]
+            dead_matmul(), unbound_axis(), dead_donation(), silent_upcast()]
 
 
 def clean_entrypoints():
     """--entrypoints payload: only clean programs (CLI must exit 0)."""
-    return [stable_carry(), device_const(), bound_axis(), live_donation()]
+    return [stable_carry(), device_const(), bound_axis(), live_donation(),
+            explicit_upcasts(), untagged_upcast()]

@@ -426,6 +426,96 @@ def test_resolved_prune_validates_and_forces_off():
     assert multi.resolved_prune() == "off"
 
 
+# ---------- bf16 certify bank (DefenseConfig.compute_dtype) ----------
+
+
+def _dtype_pair(prune="exact", margin=0.5):
+    """An f32 defense and its bf16 twin over the same mask family. The
+    bf16 side sweeps at compute_dtype="bfloat16" and escalates any image
+    whose evaluated margins land within `margin` of the argmax boundary
+    through the f32 exhaustive program — the correctness law under test."""
+    spec = masks_lib.geometry(PRUNE_IMG, 0.1)
+    f32 = PatchCleanser(_trigger_stub, spec,
+                        DefenseConfig(ratios=(0.1,), prune=prune))
+    b16 = PatchCleanser(_trigger_stub, spec,
+                        DefenseConfig(ratios=(0.1,), prune=prune,
+                                      compute_dtype="bfloat16",
+                                      incremental_margin=margin))
+    return f32, b16
+
+
+@pytest.mark.parametrize("prune", ["exact", "consensus"])
+def test_bf16_verdict_parity_all_classes(prune):
+    """Verdict parity across all four verdict classes, twice over: the
+    margin=inf bank (EVERY image escalates, so the f32 exhaustive oracle
+    decides everything — parity must be bit-exact by construction) and the
+    default-margin bank (this fixture's one-hot margins sit at 1.0, far
+    from the boundary, so bf16 decides unescalated and must still agree)."""
+    x = _prune_batch()
+    f32, _ = _dtype_pair(prune)
+    want = f32.robust_predict(None, x, PRUNE_CLASSES)
+    # the batch really covers all four classes (same check as the f32 test)
+    assert [(w.certification,
+             bool((w.preds_1 == w.preds_1[0]).all())) for w in want] == \
+        ([(True, True), (False, False), (False, True), (False, False)]
+         if prune == "exact" else
+         [(True, True), (False, False), (True, True), (False, False)])
+    for margin in (float("inf"), 0.5):
+        _, b16 = _dtype_pair(prune, margin)
+        got = b16.robust_predict(None, x, PRUNE_CLASSES)
+        for i, (w, g) in enumerate(zip(want, got)):
+            assert (g.prediction, g.certification) == \
+                (w.prediction, w.certification), \
+                f"image {i}, margin {margin}"
+    # the inf bank really escalated every image
+    assert b16.last_min_margin is not None
+
+
+def test_bf16_escalation_margin_tracked():
+    """The sweep records the per-image minimum margin that drives
+    escalation: one-hot logits put every evaluated entry at margin 1.0."""
+    _, b16 = _dtype_pair("exact", margin=float("inf"))
+    b16.robust_predict(None, _prune_batch(), PRUNE_CLASSES)
+    mm = np.asarray(b16.last_min_margin)
+    assert mm.shape == (4,)
+    np.testing.assert_allclose(mm, 1.0, atol=1e-3)
+
+
+def test_bf16_zero_recompile_ragged_sizes():
+    """The bf16 bank keeps the f32 bank's compile discipline: after
+    `warm_pruned`, ragged batch sizes share the per-bucket programs with
+    trace counts frozen, under the ARMED recompile watchdog. The warmed
+    names carry the `.bf16` tag (DP300/DP301 track the banks separately)."""
+    from dorpatch_tpu.analysis.sanitize import Sanitizer
+
+    spec = masks_lib.geometry(PRUNE_IMG, 0.1)
+    buckets = (1, 4, 8)
+    pc = PatchCleanser(_trigger_stub, spec,
+                       DefenseConfig(ratios=(0.1,), prune="exact",
+                                     compute_dtype="bfloat16"),
+                       recompile_budget=len(buckets))
+    pc.warm_pruned(None, buckets, num_classes=PRUNE_CLASSES)
+    warm = pc.pruned_trace_counts()
+    assert warm[f"defense.phase1.bf16.r{spec.patch_ratio}"] == len(buckets)
+    assert warm[f"defense.rows.bf16.r{spec.patch_ratio}"] == \
+        len(pc.row_bucket_sizes)
+    base = _prune_batch()
+    with Sanitizer(debug_nans=False, log_compiles=False):
+        for n in (1, 2, 3, 4, 5, 8):
+            idx = [i % 4 for i in range(n)]  # mixed verdict classes
+            recs = pc.robust_predict(None, base[np.asarray(idx)],
+                                     PRUNE_CLASSES, bucket_sizes=buckets)
+            assert len(recs) == n
+    assert pc.pruned_trace_counts() == warm
+
+
+def test_bf16_rejects_unknown_dtype():
+    spec = masks_lib.geometry(PRUNE_IMG, 0.1)
+    with pytest.raises(ValueError):
+        PatchCleanser(_trigger_stub, spec,
+                      DefenseConfig(ratios=(0.1,), compute_dtype="fp8"))
+
+
 # ---------- mask-aware incremental forwards (DefenseConfig.incremental) ----------
 
 INCR_IMG = 32

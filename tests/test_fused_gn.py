@@ -107,6 +107,73 @@ def test_model_level_parity_and_param_tree():
                                atol=1e-3, rtol=1e-3)
 
 
+def test_gn_preserve_dtype_matches_flax():
+    """`gn_preserve_dtype` (f32 statistics, input-dtype normalize): values
+    track flax GroupNorm at each dtype's resolution, output keeps the
+    input dtype — the contract the bf16 certify bank's victims rely on."""
+    k = jax.random.PRNGKey(41)
+    scale = _rand(jax.random.PRNGKey(42), (64,), jnp.float32) * 0.5 + 1.0
+    bias = _rand(jax.random.PRNGKey(43), (64,), jnp.float32) * 0.1
+    for dtype, atol in ((jnp.float32, 1e-5), (jnp.bfloat16, 0.06)):
+        x = _rand(k, (2, 6, 5, 64), dtype)
+        want = nn.GroupNorm(num_groups=32, epsilon=1e-5).apply(
+            {"params": {"scale": scale, "bias": bias}},
+            x.astype(jnp.float32))
+        got = fused_gn.gn_preserve_dtype(x, scale, bias, 32, eps=1e-5)
+        assert got.dtype == dtype
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want), atol=atol)
+
+
+def test_gn_preserve_dtype_no_big_f32_slabs():
+    """The point of the function: at bf16 the jitted jaxpr materializes NO
+    large f32 tensor outside the statistics reduction (flax's GroupNorm
+    runs the whole normalize chain in f32 — the DP208 leak this replaces).
+    Every full-slab f32 equation output must be a declared upcast or feed
+    only reductions."""
+    x = jnp.zeros((4, 16, 16, 64), jnp.bfloat16)
+    scale = jnp.ones((64,), jnp.float32)
+    bias = jnp.zeros((64,), jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda x: fused_gn.gn_preserve_dtype(x, scale, bias, 8))(x).jaxpr
+    slab = x.size
+    reducers = {"reduce_sum", "reduce_max"}
+    consumers = {}
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if hasattr(v, "aval"):
+                consumers.setdefault(id(v), []).append(eqn.primitive.name)
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "convert_element_type":
+            continue
+        for out in eqn.outvars:
+            if out.aval.dtype == jnp.float32 and out.aval.size >= slab:
+                used_by = consumers.get(id(out), [])
+                assert used_by and all(u in reducers for u in used_by), (
+                    f"{eqn.primitive.name} materializes a full f32 slab "
+                    f"consumed by {used_by}")
+
+
+def test_groupnorm8_f32_bit_identical_to_flax():
+    """`GroupNorm8` must be invisible at f32: same param tree as an inline
+    `nn.GroupNorm(8)` and bit-identical output (the functional apply runs
+    flax's own code — the seed's checkpoints and numerics are preserved)."""
+    from dorpatch_tpu.models.small import GroupNorm8
+
+    x = jax.random.normal(jax.random.PRNGKey(51), (2, 8, 8, 64))
+    ref = nn.GroupNorm(num_groups=8)
+    ours = GroupNorm8()
+    p_ref = ref.init(jax.random.PRNGKey(0), x)
+    p_ours = ours.init(jax.random.PRNGKey(0), x)
+    assert jax.tree_util.tree_structure(p_ref) \
+        == jax.tree_util.tree_structure(p_ours)
+    np.testing.assert_array_equal(np.asarray(ref.apply(p_ref, x)),
+                                  np.asarray(ours.apply(p_ref, x)))
+    # and at bf16 it swaps to the dtype-preserving path
+    yb = ours.apply(p_ref, x.astype(jnp.bfloat16))
+    assert yb.dtype == jnp.bfloat16
+
+
 def test_auto_dispatch_gates():
     """"auto" picks Pallas only on a single-device TPU backend AND when the
     *backward's* live set (streamed x/dy/dx blocks, double-buffered, plus

@@ -80,6 +80,64 @@ def test_attention_kernel_matches_reference():
                                atol=2e-6, rtol=2e-6)
 
 
+@pytest.mark.parametrize("k,s,pad", [(3, 1, ((1, 1), (1, 1))),
+                                     (5, 2, "same")])
+def test_stem_kernel_bf16(k, s, pad):
+    """The bf16 certify bank runs the stem kernel on bf16 operands. Two
+    contracts: kernel-vs-fold stays BIT-exact at bf16 (they share
+    `_delta_conv`, one composition, one summation order — the dtype does
+    not change that), and the bf16 result tracks the f32 fold at bf16
+    resolution (the escalation margin the engine layers on top)."""
+    if pad == "same":
+        pad = (same_pads(IMG, k, s), same_pads(IMG, k, s))
+    plan = plan_windows(_rect_table(), IMG, k, s, pad)
+    (pr0, pr1), _ = pad
+    h = (IMG + pr0 + pr1 - k) // s + 1
+    kern = jax.random.normal(jax.random.PRNGKey(0), (k, k, 3, 8))
+    clean = jax.random.normal(jax.random.PRNGKey(1), (2, h, h, 8))
+    u = jax.random.normal(jax.random.PRNGKey(2), (2, IMG, IMG, 3))
+    ref32 = fold_masked_stem(kern, clean, u, plan, (s, s), pad)
+    kb, cb, ub = (a.astype(jnp.bfloat16) for a in (kern, clean, u))
+    refb = fold_masked_stem(kb, cb, ub, plan, (s, s), pad)
+    got = fold_masked_stem_kernel(kb, cb, ub, plan, (s, s), pad,
+                                  interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                  np.asarray(refb, np.float32))
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref32), atol=0.5, rtol=0.1)
+
+
+def test_attention_kernel_bf16():
+    """bf16 q/k/v through the two-group softmax kernel vs the f32
+    reference: within bf16 resolution of the exact answer, on both the
+    tiny irregular geometry and a lane-edge head dim (f=128, the Mosaic
+    tile width — the shape the TPU lowering actually sees)."""
+    for (b, c, s, h, f, t), seed in (((2, 3, 4, 2, 8, 9), 3),
+                                     ((1, 2, 4, 1, 128, 17), 5)):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 7)
+        q = jax.random.normal(ks[0], (b, c, s, h, f))
+        kd = jax.random.normal(ks[1], (b, c, s, h, f))
+        vd = jax.random.normal(ks[2], (b, c, s, h, f))
+        kc = jax.random.normal(ks[3], (b, t, h, f))
+        vc = jax.random.normal(ks[4], (b, t, h, f))
+        clean_bias = jnp.where(jax.random.bernoulli(ks[5], 0.2, (b, c, t)),
+                               -1e9, 0.0)
+        dirty_bias = jnp.where(jax.random.bernoulli(ks[6], 0.25, (b, c, s)),
+                               -1e9, 0.0)
+        dirty_bias = dirty_bias.at[:, :, 0].set(0.0)
+        ref = masked_kv_attention_reference(q, kd, vd, kc, vc,
+                                            clean_bias, dirty_bias)
+        qb, kdb, vdb, kcb, vcb = (a.astype(jnp.bfloat16)
+                                  for a in (q, kd, vd, kc, vc))
+        got = masked_kv_attention(qb, kdb, vdb, kcb, vcb,
+                                  clean_bias, dirty_bias, interpret=True)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref), atol=0.06,
+                                   err_msg=f"shape {(b, c, s, h, f, t)}")
+
+
 def test_resolve_use_pallas_gate():
     """The shared gate: "auto" stays off on CPU hosts (the tests' own
     platform), explicit modes pass through, multi-device meshes fall back

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""bf16-certification smoke: the mixed-precision bank vs the f32 bank on a
+seeded batch (CI gate, `run_tests.sh`).
+
+Two checks:
+
+- **verdict law** — the same seeded batch certified at
+  `DefenseConfig.compute_dtype="float32"` and `"bfloat16"` must produce
+  identical verdicts, image by image. The bf16 sweep's contract is
+  identical-or-escalated: any image whose evaluated margins land within
+  `incremental_margin` of the argmax boundary re-certifies through the f32
+  exhaustive program, so a surviving mismatch is a real precision bug, not
+  noise the margin was supposed to absorb.
+- **bytes invariant** — every `defense.*.bf16.*` entry in the checked-in
+  program baseline bank must predict STRICTLY fewer HBM bytes
+  (`cost.est_bytes`) than its f32 twin (same name minus the `.bf16` tag).
+  A bf16 program pricing at or above f32 means a silent upcast snuck a
+  full-precision slab back in (the DP208 class).
+
+Prints ONE JSON line: {"metric": "certify_bf16_smoke", "parity": true,
+"escalated": ..., "bf16_entries": ..., "bytes_ratio": ...}; exits non-zero
+on any violation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dorpatch_tpu import masks as masks_lib
+    from dorpatch_tpu.config import DefenseConfig
+    from dorpatch_tpu.defense import PatchCleanser
+    from dorpatch_tpu.models.small import CifarResNet18
+
+    img, n_classes, ratio = 32, 3, 0.1
+    spec = masks_lib.geometry(img, ratio)
+    rng = np.random.default_rng(1234)
+    imgs = rng.uniform(0.0, 1.0, (3, img, img, 3)).astype(np.float32)
+    imgs[0] = 0.5                 # gray: provably first-round unanimous
+    imgs[1, :6, :6, :] = 1.0      # bright corner: disagreement inducer
+    x = jnp.asarray(imgs)
+
+    failures = []
+    stats = {"metric": "certify_bf16_smoke", "images": int(x.shape[0])}
+
+    conv = CifarResNet18(num_classes=n_classes)
+    params = conv.init(jax.random.PRNGKey(6),  # noqa: DP104 fixed smoke seed
+                       jnp.zeros((1, img, img, 3)))
+
+    def apply_fn(p, xx):
+        return conv.apply(p, (xx - 0.5) / 0.5)
+
+    def build(dtype):
+        return PatchCleanser(
+            apply_fn, spec,
+            DefenseConfig(ratios=(ratio,), prune="exact",
+                          compute_dtype=dtype))
+
+    f32 = build("float32")
+    b16 = build("bfloat16")
+    want = f32.robust_predict(params, x, n_classes, bucket_sizes=(1, 4))
+    got = b16.robust_predict(params, x, n_classes, bucket_sizes=(1, 4))
+    for i, (w, g) in enumerate(zip(want, got)):
+        if (w.prediction, w.certification) != (g.prediction,
+                                               g.certification):
+            failures.append(f"bf16 image {i}: verdict "
+                            f"({w.prediction}, {w.certification}) != "
+                            f"({g.prediction}, {g.certification})")
+    mm = np.asarray(b16.last_min_margin)
+    escalated = int((mm < b16.config.incremental_margin).sum())
+    stats.update({"escalated": escalated,
+                  "min_margin": round(float(mm.min()), 4)})
+
+    # ---- baseline bytes invariant ----
+    base = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dorpatch_tpu", "analysis",
+        "baselines.json")
+    entries = json.load(open(base)).get("entries", {})
+    bf16_bytes = f32_bytes = 0.0
+    n_bf16 = 0
+    for name, e in sorted(entries.items()):
+        if ".bf16" not in name:
+            continue
+        n_bf16 += 1
+        twin = entries.get(name.replace(".bf16", ""))
+        if twin is None:
+            failures.append(f"baseline entry {name} has no f32 twin")
+            continue
+        by = float(e["cost"]["est_bytes"])
+        twin_by = float(twin["cost"]["est_bytes"])
+        bf16_bytes += by
+        f32_bytes += twin_by
+        if not by < twin_by:
+            failures.append(
+                f"baseline entry {name}: est_bytes {by:.0f} not strictly "
+                f"below f32 twin {twin_by:.0f}")
+    if n_bf16 == 0:
+        failures.append("no defense.*.bf16.* entries in the baseline bank")
+    stats.update({"bf16_entries": n_bf16,
+                  "bytes_ratio": round(bf16_bytes / f32_bytes, 4)
+                  if f32_bytes else None})
+
+    stats.update({"parity": not failures, "failures": failures})
+    print(json.dumps(stats))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
